@@ -1,0 +1,53 @@
+"""Small shared helpers (reference: src/util.rs)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .eraftpb import Entry
+
+# A constant representing "no byte limit" (reference: util.rs:19).
+NO_LIMIT = (1 << 64) - 1
+
+# Per-entry protobuf-overhead estimate used for size accounting
+# (reference: util.rs:161-179 computes the real proto size; we model it as
+# payload bytes + a small fixed header, which preserves the *behavior* the
+# limits exist for: bounding message/ready byte sizes).
+ENTRY_OVERHEAD = 12
+
+
+def majority(total: int) -> int:
+    """Quorum size for a set of `total` voters (reference: util.rs:118-120)."""
+    return total // 2 + 1
+
+
+def entry_approximate_size(e: Entry) -> int:
+    """Byte-size estimate of an entry (reference: util.rs:161-179)."""
+    return len(e.data) + len(e.context) + ENTRY_OVERHEAD
+
+
+def limit_size(entries: List[Entry], max_size: int | None) -> None:
+    """Truncate `entries` in place so their total approximate size does not
+    exceed `max_size`, but always retain at least one entry
+    (reference: util.rs:52-75).
+
+    `None` or NO_LIMIT disables the limit.
+    """
+    if max_size is None or max_size == NO_LIMIT or len(entries) <= 1:
+        return
+    size = 0
+    limit = len(entries)
+    for i, e in enumerate(entries):
+        size += entry_approximate_size(e)
+        if size > max_size and i > 0:
+            limit = i
+            break
+    del entries[limit:]
+
+
+def is_continuous_ents(ents_a: Sequence[Entry], ents_b: Sequence[Entry]) -> bool:
+    """Whether `ents_b` directly follows `ents_a` in log order
+    (reference: util.rs:79-85)."""
+    if ents_a and ents_b:
+        return ents_a[-1].index + 1 == ents_b[0].index
+    return True
