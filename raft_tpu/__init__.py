@@ -1,0 +1,139 @@
+"""raft_tpu — a TPU-native multi-Raft consensus framework.
+
+A ground-up re-design of the capabilities of raft-rs (reference:
+/root/reference, tikv/raft-rs v0.6.0) for TPU execution:
+
+* **Scalar core** (this package root): the complete Raft consensus module —
+  `Raft`, `RawNode`/`Ready`, `RaftLog`, `Storage`/`MemStorage`,
+  `ProgressTracker`, quorum math, joint-consensus membership changes,
+  linearizable reads — a deterministic, pure function of (state, message),
+  bit-exact against the reference's semantics.  This is both a usable
+  single-group implementation and the parity oracle for the batched path.
+
+* **Batched MultiRaft path** (`raft_tpu.multiraft`): the per-group hot loop
+  (tick timers, quorum commit indices, vote tallies, progress updates) lifted
+  into JAX/XLA kernels over `[G, P]` device arrays, sharded across a TPU mesh
+  with `shard_map`/`pjit`, advancing tens of thousands of Raft groups in
+  lockstep (the BASELINE.json north star).
+
+The application-facing event loop is the Ready protocol, identical in shape
+to the reference (reference: lib.rs:176-430): tick()/step()/propose() ->
+has_ready() -> ready() -> I/O -> advance() -> advance_apply().
+"""
+
+from .config import Config, INVALID_ID, INVALID_INDEX
+from .errors import (
+    Compacted,
+    ConfChangeError,
+    ConfigInvalid,
+    ProposalDropped,
+    RaftError,
+    RequestSnapshotDropped,
+    SnapshotOutOfDate,
+    SnapshotTemporarilyUnavailable,
+    StepLocalMsg,
+    StepPeerNotFound,
+    StorageError,
+    Unavailable,
+)
+from .eraftpb import (
+    ConfChange,
+    ConfChangeSingle,
+    ConfChangeTransition,
+    ConfChangeType,
+    ConfState,
+    Entry,
+    EntryType,
+    HardState,
+    Message,
+    MessageType,
+    Snapshot,
+    SnapshotMetadata,
+    conf_state_eq,
+)
+from .log_unstable import Unstable
+from .quorum import JointConfig, MajorityConfig, VoteResult
+from .raft import (
+    CAMPAIGN_ELECTION,
+    CAMPAIGN_PRE_ELECTION,
+    CAMPAIGN_TRANSFER,
+    Raft,
+    SoftState,
+    StateRole,
+    vote_resp_msg_type,
+)
+from .raft_log import NO_LIMIT, RaftLog
+from .raw_node import (
+    LightReady,
+    Peer,
+    RawNode,
+    Ready,
+    SnapshotStatus,
+    is_local_msg,
+)
+from .read_only import ReadOnly, ReadOnlyOption, ReadState
+from .status import Status
+from .storage import MemStorage, MemStorageCore, RaftState, Storage
+from .tracker import (
+    Configuration,
+    Inflights,
+    Progress,
+    ProgressState,
+    ProgressTracker,
+)
+from .util import majority
+
+__version__ = "0.1.0"
+
+# The "prelude" of the reference (reference: lib.rs:543-570).
+__all__ = [
+    "Config",
+    "ConfChange",
+    "ConfChangeSingle",
+    "ConfChangeTransition",
+    "ConfChangeType",
+    "ConfState",
+    "Entry",
+    "EntryType",
+    "HardState",
+    "Message",
+    "MessageType",
+    "Snapshot",
+    "SnapshotMetadata",
+    "Raft",
+    "RawNode",
+    "Ready",
+    "LightReady",
+    "Peer",
+    "SnapshotStatus",
+    "RaftLog",
+    "Storage",
+    "MemStorage",
+    "MemStorageCore",
+    "RaftState",
+    "Unstable",
+    "ProgressTracker",
+    "Progress",
+    "ProgressState",
+    "Inflights",
+    "Configuration",
+    "MajorityConfig",
+    "JointConfig",
+    "VoteResult",
+    "ReadOnly",
+    "ReadOnlyOption",
+    "ReadState",
+    "SoftState",
+    "StateRole",
+    "Status",
+    "majority",
+    "conf_state_eq",
+    "is_local_msg",
+    "vote_resp_msg_type",
+    "NO_LIMIT",
+    "INVALID_ID",
+    "INVALID_INDEX",
+    "CAMPAIGN_ELECTION",
+    "CAMPAIGN_PRE_ELECTION",
+    "CAMPAIGN_TRANSFER",
+]

@@ -279,3 +279,68 @@ class ConfChangeV2:
     def leave_joint(self) -> bool:
         """An empty Auto-transition V2 change is the "leave joint" signal."""
         return self.transition == ConfChangeTransition.Auto and not self.changes
+
+
+# --- conf-change entry codec ---------------------------------------------
+#
+# The reference stores protobuf-encoded ConfChange/ConfChangeV2 in
+# Entry.data (reference: raft.rs:1995-2012 decodes them in step_leader).
+# We use a compact deterministic binary format with the same crucial
+# property: a default (empty) ConfChangeV2 encodes to b"", so the
+# auto-leave entry appended by commit_apply has zero payload size and can
+# never be refused by the uncommitted-size limiter
+# (reference: raft.rs:926-935).
+
+import struct as _struct
+
+
+def encode_conf_change(cc: ConfChange) -> bytes:
+    return _struct.pack("<BQQ", int(cc.change_type), cc.node_id, cc.id) + cc.context
+
+
+def decode_conf_change(data: bytes) -> ConfChange:
+    if not data:
+        return ConfChange()
+    if len(data) < 17:
+        raise ValueError("truncated ConfChange")
+    change_type, node_id, id = _struct.unpack_from("<BQQ", data, 0)
+    return ConfChange(
+        change_type=ConfChangeType(change_type),
+        node_id=node_id,
+        id=id,
+        context=data[17:],
+    )
+
+
+def encode_conf_change_v2(cc: ConfChangeV2) -> bytes:
+    if (
+        cc.transition == ConfChangeTransition.Auto
+        and not cc.changes
+        and not cc.context
+    ):
+        return b""
+    out = _struct.pack("<BH", int(cc.transition), len(cc.changes))
+    for c in cc.changes:
+        out += _struct.pack("<BQ", int(c.change_type), c.node_id)
+    return out + cc.context
+
+
+def decode_conf_change_v2(data: bytes) -> ConfChangeV2:
+    if not data:
+        return ConfChangeV2()
+    if len(data) < 3:
+        raise ValueError("truncated ConfChangeV2")
+    transition, n = _struct.unpack_from("<BH", data, 0)
+    off = 3
+    changes = []
+    for _ in range(n):
+        if len(data) < off + 9:
+            raise ValueError("truncated ConfChangeV2 changes")
+        ct, node_id = _struct.unpack_from("<BQ", data, off)
+        changes.append(ConfChangeSingle(ConfChangeType(ct), node_id))
+        off += 9
+    return ConfChangeV2(
+        transition=ConfChangeTransition(transition),
+        changes=changes,
+        context=data[off:],
+    )

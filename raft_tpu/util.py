@@ -51,3 +51,28 @@ def is_continuous_ents(ents_a: Sequence[Entry], ents_b: Sequence[Entry]) -> bool
     if ents_a and ents_b:
         return ents_a[-1].index + 1 == ents_b[0].index
     return True
+
+
+_U64 = (1 << 64) - 1
+
+
+def split_mix64(x: int) -> int:
+    """SplitMix64 mixing function — the counter-based PRNG both backends use
+    for randomized election timeouts, so the scalar oracle and the batched TPU
+    kernel draw IDENTICAL timeouts for the same (node, epoch) key.
+
+    Replaces the reference's `rand::thread_rng().gen_range`
+    (reference: raft.rs:2744-2756); determinism here is what makes
+    scalar-vs-TPU parity testable (SURVEY.md §7 hard-part 4).
+    """
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return z ^ (z >> 31)
+
+
+def deterministic_timeout(node_id: int, epoch: int, lo: int, hi: int) -> int:
+    """Randomized election timeout in [lo, hi) keyed by (node_id, epoch)."""
+    assert hi > lo
+    return lo + split_mix64((node_id << 32) ^ epoch) % (hi - lo)

@@ -1,0 +1,158 @@
+"""Progress FSM + Inflights tests (ported behaviors from
+reference: src/tracker/progress.rs:246-413, src/tracker/inflights.rs:127+)."""
+
+import pytest
+
+from raft_tpu.tracker import Inflights, Progress, ProgressState
+
+
+def new_progress(state, matched, next_idx, pending_snapshot, ins_size):
+    p = Progress(next_idx, ins_size)
+    p.state = state
+    p.matched = matched
+    p.pending_snapshot = pending_snapshot
+    return p
+
+
+def test_progress_is_paused():
+    tests = [
+        (ProgressState.Probe, False, False),
+        (ProgressState.Probe, True, True),
+        (ProgressState.Replicate, False, False),
+        (ProgressState.Replicate, True, False),
+        (ProgressState.Snapshot, False, True),
+        (ProgressState.Snapshot, True, True),
+    ]
+    for i, (state, paused, want) in enumerate(tests):
+        p = new_progress(state, 0, 0, 0, 256)
+        p.paused = paused
+        assert p.is_paused() == want, f"#{i}"
+
+
+def test_progress_resume():
+    p = Progress(2, 256)
+    p.paused = True
+    p.maybe_decr_to(1, 1, 0)
+    assert not p.paused
+    p.paused = True
+    p.maybe_update(2)
+    assert not p.paused
+
+
+def test_progress_become_probe():
+    matched = 1
+    tests = [
+        (new_progress(ProgressState.Replicate, matched, 5, 0, 256), 2),
+        # snapshot finish
+        (new_progress(ProgressState.Snapshot, matched, 5, 10, 256), 11),
+        # snapshot failure
+        (new_progress(ProgressState.Snapshot, matched, 5, 0, 256), 2),
+    ]
+    for i, (p, wnext) in enumerate(tests):
+        p.become_probe()
+        assert p.state == ProgressState.Probe, f"#{i}"
+        assert p.matched == matched, f"#{i}"
+        assert p.next_idx == wnext, f"#{i}"
+
+
+def test_progress_become_replicate():
+    p = new_progress(ProgressState.Probe, 1, 5, 0, 256)
+    p.become_replicate()
+    assert p.state == ProgressState.Replicate
+    assert p.matched == 1
+    assert p.next_idx == p.matched + 1
+
+
+def test_progress_become_snapshot():
+    p = new_progress(ProgressState.Probe, 1, 5, 0, 256)
+    p.become_snapshot(10)
+    assert p.state == ProgressState.Snapshot
+    assert p.matched == 1
+    assert p.pending_snapshot == 10
+
+
+def test_progress_update():
+    prev_m, prev_n = 3, 5
+    tests = [
+        (prev_m - 1, prev_m, prev_n, False),
+        (prev_m, prev_m, prev_n, False),
+        (prev_m + 1, prev_m + 1, prev_n, True),
+        (prev_m + 2, prev_m + 2, prev_n + 1, True),
+    ]
+    for i, (update, wm, wn, wok) in enumerate(tests):
+        p = Progress(prev_n, 256)
+        p.matched = prev_m
+        assert p.maybe_update(update) == wok, f"#{i}"
+        assert p.matched == wm, f"#{i}"
+        assert p.next_idx == wn, f"#{i}"
+
+
+def test_progress_maybe_decr():
+    tests = [
+        (ProgressState.Replicate, 5, 10, 5, 5, False, 10),
+        (ProgressState.Replicate, 5, 10, 4, 4, False, 10),
+        (ProgressState.Replicate, 5, 10, 9, 9, True, 6),
+        (ProgressState.Probe, 0, 0, 0, 0, False, 0),
+        (ProgressState.Probe, 0, 10, 5, 5, False, 10),
+        (ProgressState.Probe, 0, 10, 9, 9, True, 9),
+        (ProgressState.Probe, 0, 2, 1, 1, True, 1),
+        (ProgressState.Probe, 0, 1, 0, 0, True, 1),
+        (ProgressState.Probe, 0, 10, 9, 2, True, 3),
+        (ProgressState.Probe, 0, 10, 9, 0, True, 1),
+    ]
+    for i, (state, m, n, rejected, last, w, wn) in enumerate(tests):
+        p = new_progress(state, m, n, 0, 0)
+        assert p.maybe_decr_to(rejected, last, 0) == w, f"#{i}"
+        assert p.matched == m, f"#{i}"
+        assert p.next_idx == wn, f"#{i}"
+
+
+# --- Inflights (reference: inflights.rs tests) ---
+
+
+def test_inflights_add():
+    ins = Inflights(10)
+    for i in range(5):
+        ins.add(i)
+    assert ins.count == 5
+    assert list(ins._iter()) == [0, 1, 2, 3, 4]
+    for i in range(5, 10):
+        ins.add(i)
+    assert ins.full()
+    with pytest.raises(RuntimeError):
+        ins.add(10)
+
+
+def test_inflights_free_to():
+    ins = Inflights(10)
+    for i in range(10):
+        ins.add(i)
+    ins.free_to(4)
+    assert list(ins._iter()) == [5, 6, 7, 8, 9]
+    assert ins.start == 5
+    ins.free_to(8)
+    assert list(ins._iter()) == [9]
+    # rotation
+    for i in range(10, 15):
+        ins.add(i)
+    ins.free_to(12)
+    assert list(ins._iter()) == [13, 14]
+    ins.free_to(14)
+    assert ins.count == 0
+
+
+def test_inflights_free_first_one():
+    ins = Inflights(10)
+    for i in range(10):
+        ins.add(i)
+    ins.free_first_one()
+    assert ins.start == 1
+    assert ins.count == 9
+
+
+def test_inflights_free_to_below_window():
+    ins = Inflights(4)
+    ins.add(7)
+    ins.add(8)
+    ins.free_to(3)  # left of the window: no-op
+    assert ins.count == 2

@@ -1,0 +1,188 @@
+"""Quorum math tests (reference test strategy: src/quorum/datadriven_test.rs
+driving src/quorum/testdata/*.txt).
+
+Instead of porting the golden ASCII files we check the same properties with
+an independent brute-force oracle over randomized configs — stronger coverage
+of the identical input space (committed index and vote results for majority
+and joint configs, with and without group commit)."""
+
+import itertools
+import random
+
+from raft_tpu.quorum import (
+    AckIndexer,
+    Index,
+    JointConfig,
+    MajorityConfig,
+    U64_MAX,
+    VoteResult,
+)
+
+
+def brute_force_committed(voters, acked):
+    """Largest index n such that a majority of voters have acked >= n."""
+    if not voters:
+        return U64_MAX
+    indexes = sorted((acked.get(v, 0) for v in voters), reverse=True)
+    need = len(voters) // 2 + 1
+    return indexes[need - 1]
+
+
+def test_committed_index_examples():
+    # reference: majority.rs:68 doc example
+    cfg = MajorityConfig([1, 2, 3, 4, 5])
+    l = AckIndexer({i + 1: Index(index=v) for i, v in enumerate([2, 2, 2, 4, 5])})
+    assert cfg.committed_index(False, l)[0] == 2
+
+
+def test_committed_index_empty_config():
+    cfg = MajorityConfig()
+    assert cfg.committed_index(False, AckIndexer()) == (U64_MAX, True)
+
+
+def test_committed_index_missing_voters():
+    # Voters without progress count as index 0.
+    cfg = MajorityConfig([1, 2, 3])
+    l = AckIndexer({1: Index(index=9)})
+    assert cfg.committed_index(False, l)[0] == 0
+    l[2] = Index(index=5)
+    assert cfg.committed_index(False, l)[0] == 5
+
+
+def test_committed_index_randomized_vs_oracle():
+    rng = random.Random(1)
+    for _ in range(500):
+        n = rng.randint(1, 7)
+        voters = rng.sample(range(1, 16), n)
+        acked = {}
+        for v in voters:
+            if rng.random() < 0.8:
+                acked[v] = rng.randint(0, 20)
+        l = AckIndexer({v: Index(index=i) for v, i in acked.items()})
+        got = MajorityConfig(voters).committed_index(False, l)[0]
+        assert got == brute_force_committed(voters, acked), (voters, acked)
+
+
+def test_joint_committed_index_randomized():
+    rng = random.Random(2)
+    for _ in range(500):
+        incoming = rng.sample(range(1, 12), rng.randint(1, 5))
+        outgoing = rng.sample(range(1, 12), rng.randint(0, 5))
+        acked = {v: rng.randint(0, 20) for v in set(incoming) | set(outgoing)}
+        l = AckIndexer({v: Index(index=i) for v, i in acked.items()})
+        joint = JointConfig.from_majorities(
+            MajorityConfig(incoming), MajorityConfig(outgoing)
+        )
+        got = joint.committed_index(False, l)[0]
+        want = min(
+            brute_force_committed(incoming, acked),
+            brute_force_committed(outgoing, acked),
+        )
+        assert got == want, (incoming, outgoing, acked)
+
+
+def brute_force_vote(voters, votes):
+    if not voters:
+        return VoteResult.Won
+    yes = sum(1 for v in voters if votes.get(v) is True)
+    no = sum(1 for v in voters if votes.get(v) is False)
+    q = len(voters) // 2 + 1
+    if yes >= q:
+        return VoteResult.Won
+    if yes + (len(voters) - yes - no) >= q:
+        return VoteResult.Pending
+    return VoteResult.Lost
+
+
+def test_vote_result_exhaustive_small():
+    # All vote assignments for up to 5 voters.
+    for n in range(6):
+        voters = list(range(1, n + 1))
+        cfg = MajorityConfig(voters)
+        for assignment in itertools.product([True, False, None], repeat=n):
+            votes = {
+                v: a for v, a in zip(voters, assignment) if a is not None
+            }
+            got = cfg.vote_result(lambda id: votes.get(id))
+            assert got == brute_force_vote(voters, votes)
+
+
+def test_joint_vote_result_randomized():
+    rng = random.Random(3)
+    for _ in range(500):
+        incoming = rng.sample(range(1, 10), rng.randint(1, 4))
+        outgoing = rng.sample(range(1, 10), rng.randint(0, 4))
+        votes = {}
+        for v in set(incoming) | set(outgoing):
+            r = rng.random()
+            if r < 0.4:
+                votes[v] = True
+            elif r < 0.7:
+                votes[v] = False
+        joint = JointConfig.from_majorities(
+            MajorityConfig(incoming), MajorityConfig(outgoing)
+        )
+        got = joint.vote_result(lambda id: votes.get(id))
+        i = brute_force_vote(incoming, votes)
+        o = brute_force_vote(outgoing, votes)
+        if i == VoteResult.Won and o == VoteResult.Won:
+            want = VoteResult.Won
+        elif VoteResult.Lost in (i, o):
+            want = VoteResult.Lost
+        else:
+            want = VoteResult.Pending
+        assert got == want
+
+
+def test_group_commit():
+    # reference: majority.rs:69 doc example — matched/groups
+    # [(1,1), (2,2), (3,2)] commits 1 under group commit.
+    cfg = MajorityConfig([1, 2, 3])
+    l = AckIndexer(
+        {
+            1: Index(index=1, group_id=1),
+            2: Index(index=2, group_id=2),
+            3: Index(index=3, group_id=2),
+        }
+    )
+    idx, use_gc = cfg.committed_index(True, l)
+    assert (idx, use_gc) == (1, True)
+
+
+def test_group_commit_single_group_degrades():
+    cfg = MajorityConfig([1, 2, 3])
+    l = AckIndexer(
+        {
+            1: Index(index=5, group_id=1),
+            2: Index(index=4, group_id=1),
+            3: Index(index=3, group_id=1),
+        }
+    )
+    idx, use_gc = cfg.committed_index(True, l)
+    # All one group: commit the quorum index but report no group commit.
+    assert (idx, use_gc) == (4, False)
+
+
+def test_group_commit_some_ungrouped():
+    cfg = MajorityConfig([1, 2, 3])
+    l = AckIndexer(
+        {
+            1: Index(index=5, group_id=0),
+            2: Index(index=4, group_id=1),
+            3: Index(index=3, group_id=1),
+        }
+    )
+    idx, use_gc = cfg.committed_index(True, l)
+    # Mixed: falls back to the minimum matched index.
+    assert (idx, use_gc) == (3, False)
+
+
+def test_vote_result_empty_wins():
+    assert MajorityConfig().vote_result(lambda _: None) == VoteResult.Won
+
+
+def test_joint_is_singleton():
+    assert JointConfig([1]).is_singleton()
+    assert not JointConfig([1, 2]).is_singleton()
+    j = JointConfig.from_majorities(MajorityConfig([1]), MajorityConfig([2]))
+    assert not j.is_singleton()

@@ -1,0 +1,279 @@
+"""RaftLog + Unstable tests (ported behaviors from reference:
+raft_log.rs:650+ and log_unstable.rs:216+)."""
+
+import pytest
+
+from raft_tpu.eraftpb import Entry, Snapshot, SnapshotMetadata
+from raft_tpu.log_unstable import Unstable
+from raft_tpu.raft_log import RaftLog
+from raft_tpu.storage import MemStorage
+
+
+def new_entry(index, term):
+    return Entry(index=index, term=term)
+
+
+def new_snapshot(index, term):
+    return Snapshot(metadata=SnapshotMetadata(index=index, term=term))
+
+
+# --- Unstable ---
+
+
+def test_unstable_maybe_first_index():
+    u = Unstable(5)
+    u.entries = [new_entry(5, 1)]
+    assert u.maybe_first_index() is None
+    u.snapshot = new_snapshot(4, 1)
+    assert u.maybe_first_index() == 5
+
+
+def test_unstable_maybe_last_index():
+    u = Unstable(5)
+    u.entries = [new_entry(5, 1)]
+    assert u.maybe_last_index() == 5
+    u.snapshot = new_snapshot(4, 1)
+    assert u.maybe_last_index() == 5
+    u.entries = []
+    assert u.maybe_last_index() == 4
+    u.snapshot = None
+    assert u.maybe_last_index() is None
+
+
+def test_unstable_maybe_term():
+    u = Unstable(5)
+    u.entries = [new_entry(5, 1)]
+    u.snapshot = new_snapshot(4, 1)
+    assert u.maybe_term(5) == 1
+    assert u.maybe_term(6) is None
+    assert u.maybe_term(4) == 1
+    assert u.maybe_term(3) is None
+
+
+def test_unstable_restore():
+    u = Unstable(5)
+    u.entries = [new_entry(5, 1)]
+    u.snapshot = new_snapshot(4, 1)
+    s = new_snapshot(6, 2)
+    u.restore(s)
+    assert u.offset == 7
+    assert not u.entries
+    assert u.snapshot.metadata.index == 6
+
+
+def test_unstable_truncate_and_append():
+    # contiguous
+    u = Unstable(5)
+    u.truncate_and_append([new_entry(5, 1)])
+    u.truncate_and_append([new_entry(6, 1)])
+    assert [e.index for e in u.entries] == [5, 6]
+    # replace from before offset
+    u.truncate_and_append([new_entry(4, 2)])
+    assert u.offset == 4
+    assert [(e.index, e.term) for e in u.entries] == [(4, 2)]
+    # truncate within
+    u = Unstable(5)
+    u.truncate_and_append([new_entry(5, 1), new_entry(6, 1), new_entry(7, 1)])
+    u.truncate_and_append([new_entry(6, 2)])
+    assert [(e.index, e.term) for e in u.entries] == [(5, 1), (6, 2)]
+
+
+def test_unstable_stable_entries():
+    u = Unstable(5)
+    u.truncate_and_append([new_entry(5, 1), new_entry(6, 1)])
+    u.stable_entries(6, 1)
+    assert u.offset == 7
+    assert not u.entries
+    assert u.entries_size == 0
+
+
+# --- RaftLog ---
+
+
+def new_log_with_storage(store):
+    return RaftLog(store)
+
+
+def default_log(ents=()):
+    store = MemStorage()
+    if ents:
+        with store.wl() as core:
+            core.entries = list(ents)
+    return RaftLog(store)
+
+
+def test_log_append():
+    prev_ents = [new_entry(1, 1), new_entry(2, 2)]
+    tests = [
+        ([], 2, [1, 2], 3),
+        ([new_entry(3, 2)], 3, [1, 2, 3], 3),
+        # conflicts with index 1 -> replace
+        ([new_entry(1, 2)], 1, [1], 1),
+        ([new_entry(2, 3), new_entry(3, 3)], 3, [1, 2, 3], 2),
+    ]
+    for i, (ents, windex, wents, wunstable_offset) in enumerate(tests):
+        log = default_log(prev_ents)
+        assert log.append(ents) == windex, f"#{i}"
+        assert [e.index for e in log.all_entries()] == wents, f"#{i}"
+        assert log.unstable.offset == wunstable_offset, f"#{i}"
+
+
+def test_log_maybe_append():
+    # log: [1:1, 2:2, 3:3], committed=1
+    prev_ents = [new_entry(1, 1), new_entry(2, 2), new_entry(3, 3)]
+    last_index, last_term, commit = 3, 3, 1
+
+    tests = [
+        # (logTerm, index, committed, ents, wlasti(None=reject), wcommit, panic)
+        (last_term - 1, last_index, last_index, [new_entry(last_index + 1, 4)], None, commit, False),
+        (last_term, last_index + 1, last_index, [new_entry(last_index + 2, 4)], None, commit, False),
+        (last_term, last_index, last_index, [], last_index, last_index, False),
+        (last_term, last_index, last_index + 1, [new_entry(last_index + 1, 4)], last_index + 1, last_index + 1, False),
+        (last_term, last_index, last_index, [new_entry(last_index + 1, 4)], last_index + 1, last_index, False),
+        (last_term - 1, last_index - 1, last_index, [new_entry(last_index, 4)], last_index, last_index, False),
+        (last_term - 2, last_index - 2, last_index, [new_entry(last_index - 1, 4)], last_index - 1, last_index - 1, False),
+        # conflict with committed entry -> panic
+        (last_term - 3, last_index - 3, last_index, [new_entry(last_index - 2, 4)], last_index - 2, last_index - 2, True),
+        (last_term - 2, last_index - 2, last_index, [new_entry(last_index - 1, 4), new_entry(last_index, 4)], last_index, last_index, False),
+    ]
+    for i, (log_term, index, committed, ents, wlasti, wcommit, wpanic) in enumerate(tests):
+        log = default_log()
+        log.append(prev_ents)
+        log.committed = commit
+        if wpanic:
+            with pytest.raises(AssertionError):
+                log.maybe_append(index, log_term, committed, ents)
+            continue
+        res = log.maybe_append(index, log_term, committed, ents)
+        if wlasti is None:
+            assert res is None, f"#{i}"
+        else:
+            assert res is not None and res[1] == wlasti, f"#{i}"
+            assert log.committed == wcommit, f"#{i}"
+
+
+def test_log_commit_to():
+    prev_ents = [new_entry(1, 1), new_entry(2, 2), new_entry(3, 3)]
+    log = default_log()
+    log.append(prev_ents)
+    log.committed = 2
+    log.commit_to(3)
+    assert log.committed == 3
+    log.commit_to(1)  # never decrease
+    assert log.committed == 3
+    with pytest.raises(AssertionError):
+        log.commit_to(4)
+
+
+def test_log_find_conflict():
+    prev_ents = [new_entry(1, 1), new_entry(2, 2), new_entry(3, 3)]
+    tests = [
+        ([], 0),
+        ([new_entry(1, 1)], 0),
+        ([new_entry(2, 2), new_entry(3, 3)], 0),
+        ([new_entry(3, 4)], 3),
+        ([new_entry(4, 4)], 4),
+        ([new_entry(2, 1)], 2),
+    ]
+    for i, (ents, wconflict) in enumerate(tests):
+        log = default_log()
+        log.append(prev_ents)
+        assert log.find_conflict(ents) == wconflict, f"#{i}"
+
+
+def test_log_find_conflict_by_term():
+    ents = [new_entry(2, 2), new_entry(3, 2), new_entry(4, 4), new_entry(5, 4), new_entry(6, 6)]
+    store = MemStorage()
+    with store.wl() as core:
+        core.snapshot_metadata = SnapshotMetadata(index=1, term=2)
+        core.entries = []
+    log = RaftLog(store)
+    log.append(ents)
+    # (index, term) -> expected index
+    assert log.find_conflict_by_term(6, 6)[0] == 6
+    assert log.find_conflict_by_term(6, 5)[0] == 5
+    assert log.find_conflict_by_term(6, 4)[0] == 5
+    assert log.find_conflict_by_term(6, 2)[0] == 3
+    # Below the snapshot boundary term() reports 0, which is <= the probe
+    # term, so the scan stops at index 0 (matches the reference's term()
+    # out-of-range convention, raft_log.rs:122-127).
+    assert log.find_conflict_by_term(6, 1)[0] == 0
+
+
+def test_log_is_up_to_date():
+    prev_ents = [new_entry(1, 1), new_entry(2, 2), new_entry(3, 3)]
+    log = default_log()
+    log.append(prev_ents)
+    tests = [
+        (log.last_index() - 1, 4, True),
+        (log.last_index(), 4, True),
+        (log.last_index() + 1, 4, True),
+        (log.last_index() - 1, 2, False),
+        (log.last_index(), 3, True),
+        (log.last_index() + 1, 3, True),
+        (log.last_index() - 1, 3, False),
+    ]
+    for i, (last_index, term, w) in enumerate(tests):
+        assert log.is_up_to_date(last_index, term) == w, f"#{i}"
+
+
+def test_log_term():
+    offset = 100
+    num = 100
+    store = MemStorage()
+    with store.wl() as core:
+        core.snapshot_metadata = SnapshotMetadata(index=offset, term=1)
+    log = RaftLog(store)
+    for i in range(1, num):
+        log.append([new_entry(offset + i, i)])
+    assert log.term(offset) == 1
+    assert log.term(offset + num - 1) == num - 1
+    assert log.term(offset - 1) == 0
+    assert log.term(offset + num) == 0
+
+
+def test_log_persisted_tracking():
+    log = default_log()
+    log.append([new_entry(1, 1), new_entry(2, 1)])
+    assert log.persisted == 0
+    # Entries not in storage can't be persisted.
+    assert not log.maybe_persist(2, 1)
+    with log.store.wl() as core:
+        core.append(log.unstable_entries())
+    log.stable_entries(2, 1)
+    assert log.maybe_persist(2, 1)
+    assert log.persisted == 2
+    # Restore regresses persisted to committed.
+    log.committed = 1
+    log.restore(new_snapshot(5, 2))
+    assert log.persisted == 1
+    assert log.committed == 5
+
+
+def test_log_next_entries():
+    ents = [new_entry(4, 1), new_entry(5, 1), new_entry(6, 1)]
+    store = MemStorage()
+    with store.wl() as core:
+        core.snapshot_metadata = SnapshotMetadata(index=3, term=1)
+    log = RaftLog(store)
+    log.append(ents)
+    log.committed = 5
+    with log.store.wl() as core:
+        core.append(log.unstable_entries())
+    log.stable_entries(6, 1)
+    log.maybe_persist(6, 1)
+    log.applied_to(4)
+    assert [e.index for e in log.next_entries()] == [5]
+    log.applied_to(5)
+    assert log.next_entries() is None
+    assert not log.has_next_entries()
+
+
+def test_log_slice_across_unstable():
+    store = MemStorage()
+    with store.wl() as core:
+        core.entries = [new_entry(1, 1), new_entry(2, 1)]
+    log = RaftLog(store)
+    log.append([new_entry(3, 2), new_entry(4, 2)])
+    got = log.slice(1, 5, None)
+    assert [e.index for e in got] == [1, 2, 3, 4]

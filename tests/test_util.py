@@ -1,0 +1,155 @@
+"""Shared test builders (reference: harness/tests/test_util/mod.rs:27-219)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from raft_tpu import (
+    Config,
+    ConfState,
+    Entry,
+    HardState,
+    MemStorage,
+    Message,
+    MessageType,
+    Raft,
+    RawNode,
+    Snapshot,
+    SnapshotMetadata,
+)
+from raft_tpu.harness import Interface, Network
+from raft_tpu.raft_log import NO_LIMIT
+
+
+def ltoa(raft: Raft) -> str:
+    """Render a raft's log for golden comparisons."""
+    s = f"committed: {raft.raft_log.committed}\n"
+    s += f"applied: {raft.raft_log.applied}\n"
+    for i, e in enumerate(raft.raft_log.all_entries()):
+        s += f"#{i}: term:{e.term} index:{e.index}\n"
+    return s
+
+
+def new_storage() -> MemStorage:
+    return MemStorage()
+
+def new_test_config(id: int, election_tick: int, heartbeat_tick: int) -> Config:
+    """reference: test_util/mod.rs:36-44"""
+    return Config(
+        id=id,
+        election_tick=election_tick,
+        heartbeat_tick=heartbeat_tick,
+        max_size_per_msg=NO_LIMIT,
+        max_inflight_msgs=256,
+    )
+
+
+def new_test_raft(
+    id: int,
+    peers: List[int],
+    election: int,
+    heartbeat: int,
+    storage: Optional[MemStorage] = None,
+) -> Interface:
+    """reference: test_util/mod.rs:54-77"""
+    config = new_test_config(id, election, heartbeat)
+    if storage is None:
+        storage = MemStorage()
+    initial = storage.initial_state()
+    if peers and not initial.initialized():
+        storage.initialize_with_conf_state((peers, []))
+    return new_test_raft_with_config(config, storage)
+
+
+def new_test_raft_with_prevote(
+    id: int, peers: List[int], election: int, heartbeat: int,
+    storage: Optional[MemStorage] = None, pre_vote: bool = True,
+) -> Interface:
+    config = new_test_config(id, election, heartbeat)
+    config.pre_vote = pre_vote
+    if storage is None:
+        storage = MemStorage()
+    initial = storage.initial_state()
+    if peers and not initial.initialized():
+        storage.initialize_with_conf_state((peers, []))
+    return new_test_raft_with_config(config, storage)
+
+
+def new_test_raft_with_config(config: Config, storage: MemStorage) -> Interface:
+    return Interface(Raft(config, storage))
+
+
+def new_test_raw_node(
+    id: int, peers: List[int], election: int, heartbeat: int,
+    storage: Optional[MemStorage] = None,
+) -> RawNode:
+    config = new_test_config(id, election, heartbeat)
+    if storage is None:
+        storage = MemStorage()
+    if peers and not storage.initial_state().initialized():
+        storage.initialize_with_conf_state((peers, []))
+    return RawNode(config, storage)
+
+
+def new_message(from_: int, to: int, t: MessageType, n: int = 0) -> Message:
+    """reference: test_util/mod.rs:127-139"""
+    m = Message(msg_type=t, to=to, from_=from_)
+    if n > 0:
+        m.entries = [new_entry(0, 0, SOME_DATA) for _ in range(n)]
+    return m
+
+
+def new_message_with_entries(
+    from_: int, to: int, t: MessageType, ents: List[Entry]
+) -> Message:
+    return Message(msg_type=t, to=to, from_=from_, entries=ents)
+
+
+SOME_DATA = b"somedata"
+
+
+def new_entry(term: int, index: int, data: Optional[bytes] = None) -> Entry:
+    """reference: test_util/mod.rs:113-121"""
+    e = Entry(term=term, index=index)
+    if data:
+        e.data = data
+    return e
+
+
+def empty_entry(term: int, index: int) -> Entry:
+    return new_entry(term, index, None)
+
+
+def new_snapshot(index: int, term: int, voters: List[int]) -> Snapshot:
+    """reference: test_util/mod.rs:142-151"""
+    return Snapshot(
+        metadata=SnapshotMetadata(
+            conf_state=ConfState(voters=voters),
+            index=index,
+            term=term,
+        )
+    )
+
+
+def new_hard_state(term: int, vote: int, commit: int) -> HardState:
+    return HardState(term=term, vote=vote, commit=commit)
+
+
+__all__ = [
+    "ltoa",
+    "new_storage",
+    "new_test_config",
+    "new_test_raft",
+    "new_test_raft_with_prevote",
+    "new_test_raft_with_config",
+    "new_test_raw_node",
+    "new_message",
+    "new_message_with_entries",
+    "new_entry",
+    "empty_entry",
+    "new_snapshot",
+    "new_hard_state",
+    "SOME_DATA",
+    "Network",
+    "Interface",
+]
