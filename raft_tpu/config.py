@@ -58,6 +58,11 @@ class Config:
     max_uncommitted_size: int = NO_LIMIT
     # Byte cap on committed entries delivered per Ready (pagination).
     max_committed_size_per_ready: int = MAX_COMMITTED_SIZE_PER_READY
+    # raft-tpu extension: seed mixed into the deterministic election-timeout
+    # PRNG key (node_key = timeout_seed * 2**16 + id).  Lets many groups that
+    # share peer ids 1..P (the MultiRaft batch) draw independent timeout
+    # streams while staying bit-identical to the device kernel.
+    timeout_seed: int = 0
 
     def min_election_tick_or_default(self) -> int:
         """reference: config.rs:129-136"""

@@ -1,0 +1,281 @@
+"""Batched Raft kernels as pure jnp functions over [..., P] peer planes.
+
+Each kernel is the vectorized equivalent of a scalar-oracle function:
+
+  committed_index          <-> quorum.MajorityConfig.committed_index
+                               (reference: majority.rs:70-124)
+  joint_committed_index    <-> quorum.JointConfig.committed_index
+                               (reference: joint.rs:47-51)
+  vote_result              <-> quorum.MajorityConfig.vote_result
+                               (reference: majority.rs:130-154)
+  timeout_draw             <-> util.deterministic_timeout (both sides use the
+                               same 32-bit mixer; reference replaces
+                               raft.rs:2744-2756)
+  tick_kernel              <-> Raft.tick_election / tick_heartbeat
+                               (reference: raft.rs:1024-1079)
+
+TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
+committed_index is a fixed-width masked sort along the last axis that XLA
+lowers to a compare-exchange network on the VPU — no MXU involvement, no
+dynamic shapes, fully fusable with the surrounding elementwise ops.  All
+dtypes are int32/bool (indices < 2^31 in practice; the scalar oracle checks
+overflow), so no x64 dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.int32(2**31 - 1)
+
+# Vote results as int codes matching quorum.VoteResult.
+VOTE_PENDING = 0
+VOTE_LOST = 1
+VOTE_WON = 2
+
+
+def majority_of(count: jnp.ndarray) -> jnp.ndarray:
+    """Quorum size: n // 2 + 1 (reference: util.rs:118-120)."""
+    return count // 2 + 1
+
+
+def committed_index(
+    matched: jnp.ndarray, voter_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-group quorum commit index over the peer axis.
+
+    matched:    int32[..., P] acked index per peer (leader's Progress.matched)
+    voter_mask: bool[..., P]  which peers are voters of this majority config
+
+    Returns int32[...]: the majority()-th largest matched among voters; INF
+    for an empty config (so joint min() ignores it), exactly the reference's
+    empty-config convention (majority.rs:71-75).
+
+    Padding argument: non-voters are masked to 0.  Since matched >= 0, the
+    k-th largest over (voters ∪ zero-padding) equals the k-th largest over
+    voters alone for k <= |voters| — zeros can only displace other zeros.
+    """
+    masked = jnp.where(voter_mask, matched, 0)
+    srt = jnp.sort(masked, axis=-1)  # ascending
+    count = jnp.sum(voter_mask, axis=-1).astype(jnp.int32)
+    q = majority_of(count)
+    p = matched.shape[-1]
+    # k-th largest = srt[P - q] (ascending sort), guarded for empty configs.
+    idx = jnp.clip(p - q, 0, p - 1)
+    quorum_idx = jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(count == 0, INF, quorum_idx)
+
+
+def committed_index_grouped(
+    matched: jnp.ndarray, group_ids: jnp.ndarray, voter_mask: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Group-commit variant (reference: majority.rs:99-124): commits need
+    acks from >= 2 distinct commit groups.
+
+    matched:   int32[..., P]
+    group_ids: int32[..., P] commit group per peer (0 = unassigned)
+    voter_mask: bool[..., P]
+
+    Returns (index[...], use_group_commit[...]):
+      * >= 2 distinct non-zero groups among voters -> min(quorum_index,
+        max matched of any voter outside the quorum group scan) — computed
+        exactly as the reference does: walking the reverse-sorted list, the
+        first voter whose non-zero group differs from the quorum entry's
+        (first non-zero seen) group caps the result.
+      * single non-zero group        -> (quorum_index, False)
+      * any zero group among voters  -> falls back to min matched, False
+        (unless a differing pair is found first).
+    """
+    p = matched.shape[-1]
+    # Reverse sort by index, carrying group ids along.  Non-voters are
+    # keyed -1 so they sort strictly AFTER every voter (a padded 0 must not
+    # displace a genuine voter entry with matched == 0 — the group scan
+    # walks exactly the first `count` sorted entries).
+    masked = jnp.where(voter_mask, matched, -1)
+    masked_groups = jnp.where(voter_mask, group_ids, 0)
+    order = jnp.argsort(-masked, axis=-1, stable=True)
+    masked = jnp.where(voter_mask, matched, 0)
+    srt_idx = jnp.take_along_axis(masked, order, axis=-1)
+    srt_grp = jnp.take_along_axis(masked_groups, order, axis=-1)
+    count = jnp.sum(voter_mask, axis=-1).astype(jnp.int32)
+    q = majority_of(count)
+    qpos = jnp.clip(q - 1, 0, p - 1)
+    quorum_index = jnp.take_along_axis(srt_idx, qpos[..., None], axis=-1)[..., 0]
+    quorum_group = jnp.take_along_axis(srt_grp, qpos[..., None], axis=-1)[..., 0]
+
+    # Scalar scan (majority.rs:102-123) vectorized via a P-step fori over the
+    # sorted voters — P is tiny and static so this unrolls.
+    def body(i, carry):
+        checked_group, single_group, result, done = carry
+        in_range = i < count
+        g = srt_grp[..., i]
+        ix = srt_idx[..., i]
+        is_zero = (g == 0) & in_range
+        single_group = single_group & ~is_zero
+        take_group = (checked_group == 0) & (g != 0) & in_range & ~done
+        differs = (
+            (checked_group != 0) & (g != 0) & (g != checked_group) & in_range & ~done
+        )
+        result = jnp.where(differs, jnp.minimum(ix, quorum_index), result)
+        done = done | differs
+        checked_group = jnp.where(take_group, g, checked_group)
+        return checked_group, single_group, result, done
+
+    shape = matched.shape[:-1]
+    carry = (
+        quorum_group,
+        jnp.ones(shape, dtype=bool),
+        jnp.zeros(shape, dtype=jnp.int32),
+        jnp.zeros(shape, dtype=bool),
+    )
+    checked_group, single_group, result, done = jax.lax.fori_loop(
+        0, p, body, carry
+    )
+    # Smallest matched among voters (the last in-range sorted entry).
+    last_pos = jnp.clip(count - 1, 0, p - 1)
+    min_matched = jnp.take_along_axis(srt_idx, last_pos[..., None], axis=-1)[..., 0]
+    fallback = jnp.where(single_group, quorum_index, min_matched)
+    index = jnp.where(done, result, fallback)
+    use_gc = done
+    index = jnp.where(count == 0, INF, index)
+    use_gc = jnp.where(count == 0, True, use_gc)
+    return index, use_gc
+
+
+def joint_committed_index(
+    matched: jnp.ndarray,
+    incoming_mask: jnp.ndarray,
+    outgoing_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Joint config: min over both majorities (reference: joint.rs:47-51).
+    An empty outgoing half returns INF from committed_index, so min()
+    reduces to the incoming half."""
+    return jnp.minimum(
+        committed_index(matched, incoming_mask),
+        committed_index(matched, outgoing_mask),
+    )
+
+
+def vote_result(
+    granted: jnp.ndarray, rejected: jnp.ndarray, voter_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Vote outcome over the peer axis (reference: majority.rs:130-154).
+
+    granted/rejected: bool[..., P] votes recorded (both False = missing)
+    voter_mask:       bool[..., P]
+
+    Returns int32[...] VOTE_{PENDING,LOST,WON}; empty configs win.
+    """
+    g = jnp.sum(granted & voter_mask, axis=-1).astype(jnp.int32)
+    r = jnp.sum(rejected & voter_mask, axis=-1).astype(jnp.int32)
+    count = jnp.sum(voter_mask, axis=-1).astype(jnp.int32)
+    q = majority_of(count)
+    missing = count - g - r
+    won = (g >= q) | (count == 0)
+    pending = (g + missing >= q) & ~won
+    return jnp.where(won, VOTE_WON, jnp.where(pending, VOTE_PENDING, VOTE_LOST))
+
+
+def joint_vote_result(
+    granted: jnp.ndarray,
+    rejected: jnp.ndarray,
+    incoming_mask: jnp.ndarray,
+    outgoing_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """reference: joint.rs:56-67"""
+    i = vote_result(granted, rejected, incoming_mask)
+    o = vote_result(granted, rejected, outgoing_mask)
+    won = (i == VOTE_WON) & (o == VOTE_WON)
+    lost = (i == VOTE_LOST) | (o == VOTE_LOST)
+    return jnp.where(won, VOTE_WON, jnp.where(lost, VOTE_LOST, VOTE_PENDING))
+
+
+def timeout_draw(
+    node_key: jnp.ndarray, epoch: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray
+) -> jnp.ndarray:
+    """Randomized election timeout in [lo, hi) — the device side of
+    util.deterministic_timeout (identical 32-bit murmur3-finalizer mix)."""
+    x = (
+        node_key.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        + epoch.astype(jnp.uint32)
+    )
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    span = (hi - lo).astype(jnp.uint32)
+    return (lo.astype(jnp.uint32) + x % span).astype(jnp.int32)
+
+
+# State role codes matching raft.StateRole.
+ROLE_FOLLOWER = 0
+ROLE_CANDIDATE = 1
+ROLE_LEADER = 2
+ROLE_PRE_CANDIDATE = 3
+
+
+def tick_kernel(
+    state: jnp.ndarray,
+    election_elapsed: jnp.ndarray,
+    heartbeat_elapsed: jnp.ndarray,
+    randomized_timeout: jnp.ndarray,
+    promotable: jnp.ndarray,
+    election_timeout: int,
+    heartbeat_timeout: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One logical-clock tick for every node in the batch
+    (reference: raft.rs:1024-1079).
+
+    All args are int32/bool arrays of one shape (any rank — [G] for a
+    MultiRaft node, [G, P] for the closed-loop sim).
+
+    Returns (election_elapsed', heartbeat_elapsed', want_campaign,
+    want_heartbeat, want_check_quorum):
+      * non-leaders: elapsed+1; timeout & promotable -> want_campaign with
+        elapsed reset (reference: raft.rs:1037-1047)
+      * leaders: heartbeat_elapsed+1 and election_elapsed+1; heartbeat
+        timeout -> want_heartbeat; election timeout -> want_check_quorum
+        (reference: raft.rs:1051-1079)
+
+    The caller (driver/sim) turns the masks into MsgHup/MsgBeat/
+    MsgCheckQuorum effects; timer arithmetic itself never leaves the device.
+    """
+    is_leader = state == ROLE_LEADER
+
+    ee = election_elapsed + 1
+    hb = jnp.where(is_leader, heartbeat_elapsed + 1, heartbeat_elapsed)
+
+    pass_election = ee >= randomized_timeout
+    want_campaign = (~is_leader) & pass_election & promotable
+    ee = jnp.where(want_campaign, 0, ee)
+
+    leader_election_timeout = is_leader & (ee >= election_timeout)
+    want_check_quorum = leader_election_timeout
+    ee = jnp.where(leader_election_timeout, 0, ee)
+
+    want_heartbeat = is_leader & (hb >= heartbeat_timeout)
+    hb = jnp.where(want_heartbeat, 0, hb)
+
+    return ee, hb, want_campaign, want_heartbeat, want_check_quorum
+
+
+def append_response_update(
+    matched: jnp.ndarray,
+    next_idx: jnp.ndarray,
+    resp_index: jnp.ndarray,
+    resp_mask: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched Progress.maybe_update for accepted append responses
+    (reference: progress.rs:138-150): matched = max(matched, index),
+    next = max(next, index + 1), applied only under resp_mask."""
+    new_matched = jnp.where(
+        resp_mask, jnp.maximum(matched, resp_index), matched
+    )
+    new_next = jnp.where(
+        resp_mask, jnp.maximum(next_idx, resp_index + 1), next_idx
+    )
+    return new_matched, new_next

@@ -1,0 +1,358 @@
+"""ClusterSim: closed-loop on-device simulation of G Raft groups × P peers.
+
+This is the intra-pod co-located-groups execution mode (SURVEY.md §5.8a):
+all P replicas of each group live in the same `[G, P]` device planes, so the
+entire message exchange of one protocol round — vote requests/responses,
+append broadcast and acks, heartbeats, commit propagation — reduces to array
+permutations and masked reductions.  One `step()` advances every group by one
+tick AND settles all resulting traffic, exactly like the scalar harness's
+"tick all peers, pump to quiescence" round (see simref.ScalarCluster, the
+parity oracle).
+
+Protocol scope of v1 (what BASELINE configs 2/3/5 need):
+  * elections with randomized timeouts (counter PRNG keyed (node, term)),
+    log-up-to-date vote checks, split votes, term inflation from isolated
+    peers, stale-candidate disruption on recovery;
+  * steady-state replication with per-round append workloads and quorum
+    commit (term-gated, Raft §5.4.2 via the term_start_index trick);
+  * fault injection by per-round crash (isolation) masks — crashed peers
+    keep ticking and campaigning but exchange no messages.
+  Not modeled on device yet (host path handles them): pre-vote,
+  check-quorum, joint reconfig mid-flight, snapshots, divergent log tails
+  (impossible under instant in-round replication — see maybe_append note).
+
+Faithfulness argument for logs: within a round every append reaches every
+alive peer and is acked (instant delivery, pump to quiescence), so an
+entry either reaches all alive peers or (its author having crashed at a
+round boundary) was never created.  Logs are therefore always prefixes of
+each other and `maybe_append` can never conflict — which is why last_index/
+last_term per peer is a sufficient log model and the conflict scan stays
+host-side (SURVEY.md §7 hard-part 3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import kernels
+from .kernels import ROLE_CANDIDATE, ROLE_FOLLOWER, ROLE_LEADER
+
+
+class SimConfig(NamedTuple):
+    """Static per-sim configuration (python ints: shapes and timeouts are
+    compile-time constants for XLA)."""
+
+    n_groups: int
+    n_peers: int
+    election_tick: int = 10
+    heartbeat_tick: int = 1
+
+    @property
+    def min_timeout(self) -> int:
+        return self.election_tick
+
+    @property
+    def max_timeout(self) -> int:
+        return 2 * self.election_tick
+
+
+class SimState(NamedTuple):
+    """Device-resident SoA state, all [G, P] int32/bool (SURVEY.md §7
+    phase 4 state inventory)."""
+
+    term: jnp.ndarray
+    state: jnp.ndarray  # ROLE_* codes
+    vote: jnp.ndarray  # 0 = none, else peer id (1..P)
+    leader_id: jnp.ndarray  # each peer's view; 0 = none
+    election_elapsed: jnp.ndarray
+    heartbeat_elapsed: jnp.ndarray
+    randomized_timeout: jnp.ndarray
+    last_index: jnp.ndarray
+    last_term: jnp.ndarray
+    commit: jnp.ndarray
+    # Group-level leader bookkeeping:
+    matched: jnp.ndarray  # [G, P] acting leader's Progress.matched view
+    term_start_index: jnp.ndarray  # [G] index of the leader's noop entry
+    voter_mask: jnp.ndarray  # [G, P] static config
+
+
+def _node_key(cfg: SimConfig) -> jnp.ndarray:
+    """node_key[g, p] = g * 2**16 + (p + 1): matches the scalar side's
+    Config.timeout_seed = g convention (util.deterministic_timeout)."""
+    g = jnp.arange(cfg.n_groups, dtype=jnp.uint32)[:, None]
+    p = jnp.arange(cfg.n_peers, dtype=jnp.uint32)[None, :]
+    return g * jnp.uint32(1 << 16) + (p + 1)
+
+
+def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimState:
+    """All peers start as followers at term 0 with their deterministic
+    timeout draw (mirrors Raft.__init__ -> become_follower(0))."""
+    G, P = cfg.n_groups, cfg.n_peers
+    shape = (G, P)
+
+    def zeros():
+        # Distinct buffers per field: step() donates the whole state, and
+        # aliased buffers would be donated twice.
+        return jnp.zeros(shape, jnp.int32)
+
+    if voter_mask is None:
+        voter_mask = jnp.ones(shape, bool)
+    lo = jnp.full(shape, cfg.min_timeout, jnp.int32)
+    hi = jnp.full(shape, cfg.max_timeout, jnp.int32)
+    rt = kernels.timeout_draw(_node_key(cfg), jnp.zeros(shape, jnp.uint32), lo, hi)
+    return SimState(
+        term=zeros(),
+        state=zeros(),
+        vote=zeros(),
+        leader_id=zeros(),
+        election_elapsed=zeros(),
+        heartbeat_elapsed=zeros(),
+        randomized_timeout=rt,
+        last_index=zeros(),
+        last_term=zeros(),
+        commit=zeros(),
+        matched=zeros(),
+        term_start_index=jnp.zeros((G,), jnp.int32),
+        voter_mask=voter_mask,
+    )
+
+
+def step(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,
+    append_n: jnp.ndarray,
+) -> SimState:
+    """One lockstep protocol round for every group.
+
+    crashed:  bool[G, P] peers isolated this round (keep ticking, no I/O)
+    append_n: int32[G]   entries proposed at the group's leader this round
+
+    The round = the scalar oracle's (tick all peers) + (pump to quiescence)
+    + (propose at leader) + (pump), expressed as four masked phases.
+    """
+    G, P = cfg.n_groups, cfg.n_peers
+    self_id = jnp.arange(P, dtype=jnp.int32)[None, :] + 1
+    alive = ~crashed
+    node_key = _node_key(cfg)
+    lo = jnp.full((G, P), cfg.min_timeout, jnp.int32)
+    hi = jnp.full((G, P), cfg.max_timeout, jnp.int32)
+
+    def draw(term):
+        return kernels.timeout_draw(node_key, term.astype(jnp.uint32), lo, hi)
+
+    # ---- Phase A: tick every peer (crashed peers tick too — isolation cuts
+    # the network, not their clock), reference: raft.rs:1024-1079.
+    ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
+        st.state,
+        st.election_elapsed,
+        st.heartbeat_elapsed,
+        st.randomized_timeout,
+        st.voter_mask,  # promotable == is a voter
+        cfg.election_tick,
+        cfg.heartbeat_tick,
+    )
+
+    # ---- Phase B: campaigners become candidates (reference: raft.rs
+    # become_candidate 1101-1117): term+1, vote self, redraw timeout.
+    term = st.term + want_campaign.astype(jnp.int32)
+    state = jnp.where(want_campaign, ROLE_CANDIDATE, st.state)
+    vote = jnp.where(want_campaign, self_id, st.vote)
+    leader_id = jnp.where(want_campaign, 0, st.leader_id)
+    rt = jnp.where(want_campaign, draw(term), st.randomized_timeout)
+
+    # ---- Phase C: election resolution among alive requesters.
+    # Only this round's campaigners broadcast MsgRequestVote (a pending
+    # candidate from an earlier round waits for its own next timeout).
+    req = want_campaign & alive
+    any_req = jnp.any(req, axis=-1)  # [G]
+    t_star = jnp.max(jnp.where(req, term, 0), axis=-1)  # [G]
+
+    # Receiving a higher-term request makes any alive peer a follower at
+    # that term with vote cleared (reference: raft.rs:1284-1348).
+    bump = alive & (term < t_star[:, None]) & any_req[:, None]
+    term_c = jnp.where(bump, t_star[:, None], term)
+    state_c = jnp.where(bump, ROLE_FOLLOWER, state)
+    vote_c = jnp.where(bump, 0, vote)
+    leader_c = jnp.where(bump, 0, leader_id)
+    ee = jnp.where(bump, 0, ee)
+    hb = jnp.where(bump, 0, hb)
+    rt = jnp.where(bump, draw(term_c), rt)
+
+    # Candidates actually contending are requesters whose (pre-bump) term
+    # IS t_star; lower-term requesters just got deposed by the bump.
+    cand = req & (term == t_star[:, None])  # [G, P]
+
+    # Vote decision per alive voter v (reference: raft.rs:1418-1461):
+    # can_vote (vote empty after bump) & candidate log up-to-date; ties in
+    # the same round resolve to the lowest peer index because the scalar
+    # pump delivers requests in peer order.
+    #   axes: [G, c, v]
+    lt_c = st.last_term[:, :, None]
+    li_c = st.last_index[:, :, None]
+    lt_v = st.last_term[:, None, :]
+    li_v = st.last_index[:, None, :]
+    up_to_date = (lt_c > lt_v) | ((lt_c == lt_v) & (li_c >= li_v))
+    elig = cand[:, :, None] & up_to_date  # candidate c eligible for voter v
+
+    c_idx = jnp.arange(P, dtype=jnp.int32)[None, :, None]
+    first_elig = jnp.min(jnp.where(elig, c_idx, P), axis=1)  # [G, v]
+    # Voters respond only if alive, a voter, and at exactly t_star after the
+    # bump (peers with higher terms silently ignore stale requests).
+    responder = alive & st.voter_mask & (term_c == t_star[:, None]) & any_req[:, None]
+    can_vote = (vote_c == 0) & responder
+    grant_to = jnp.where(can_vote & (first_elig < P), first_elig, -1)  # [G, v]
+
+    # votes_for[c] = grants + self-vote.
+    grants = jnp.sum(
+        (grant_to[:, None, :] == c_idx) & (grant_to[:, None, :] >= 0),
+        axis=-1,
+    ).astype(jnp.int32)
+    votes_for = grants + cand.astype(jnp.int32)
+    n_voters = jnp.sum(st.voter_mask, axis=-1).astype(jnp.int32)  # [G]
+    n_responders = jnp.sum(responder, axis=-1).astype(jnp.int32)
+    quorum = n_voters // 2 + 1
+    # Voters that never respond (crashed or ahead in term) are "missing".
+    missing = n_voters - n_responders
+    won = cand & (votes_for >= quorum[:, None])
+    lost = cand & (votes_for + missing[:, None] < quorum[:, None])
+
+    winner_exists = jnp.any(won, axis=-1)  # [G]
+    widx = jnp.argmax(won, axis=-1).astype(jnp.int32)  # [G]
+
+    # Record granted votes (reference: raft.rs:1445-1449).
+    vote_c = jnp.where(grant_to >= 0, grant_to + 1, vote_c)
+
+    # Winner becomes leader and appends its noop entry (reference:
+    # raft.rs:1151-1202); losers with a decided election step down.
+    is_winner = won  # at most one per group
+    new_last_index = jnp.where(is_winner, st.last_index + 1, st.last_index)
+    new_last_term = jnp.where(is_winner, t_star[:, None], st.last_term)
+    state_c = jnp.where(is_winner, ROLE_LEADER, state_c)
+    leader_c = jnp.where(is_winner, self_id, leader_c)
+    rt = jnp.where(is_winner, draw(term_c), rt)  # become_leader -> reset
+    ee = jnp.where(is_winner, 0, ee)
+    hb = jnp.where(is_winner, 0, hb)
+    # A losing candidate steps down when it sees the winner's append (same
+    # term) or a quorum of rejections (reference: raft.rs:2192-2197,
+    # 2215-2219).
+    step_down = cand & ~won & (lost | (winner_exists[:, None] & alive))
+    state_c = jnp.where(step_down, ROLE_FOLLOWER, state_c)
+    rt = jnp.where(step_down, draw(term_c), rt)
+    ee = jnp.where(step_down, 0, ee)
+
+    # New leader's tracker: matched = last for alive peers (they ack the
+    # noop in-round), 0 for crashed ones (probe state after reset;
+    # reference: raft.rs:942-971 + the in-round acks).
+    term_start = jnp.where(
+        winner_exists,
+        jnp.take_along_axis(new_last_index, widx[:, None], axis=1)[:, 0],
+        st.term_start_index,
+    )
+
+    # ---- Phase D: replication round for groups with an alive leader.
+    is_leader = (state_c == ROLE_LEADER) & alive
+    has_leader = jnp.any(is_leader, axis=-1)  # [G]
+    # The acting leader is the alive leader with the highest term (a stale
+    # recovered leader loses this and gets synced down below).
+    lead_score = jnp.where(is_leader, term_c, -1)
+    lidx = jnp.argmax(lead_score, axis=-1).astype(jnp.int32)  # [G]
+    lead_term = jnp.take_along_axis(term_c, lidx[:, None], axis=1)[:, 0]
+
+    # Append workload at the leader (entries stamped with its term).
+    n_app = jnp.where(has_leader, append_n, 0)  # [G]
+    is_acting_leader = (
+        jnp.arange(P, dtype=jnp.int32)[None, :] == lidx[:, None]
+    ) & has_leader[:, None]
+    new_last_index = new_last_index + jnp.where(is_acting_leader, n_app[:, None], 0)
+    new_last_term = jnp.where(is_acting_leader, lead_term[:, None], new_last_term)
+
+    lead_last = jnp.take_along_axis(new_last_index, lidx[:, None], axis=1)[:, 0]
+    lead_last_term = jnp.take_along_axis(new_last_term, lidx[:, None], axis=1)[:, 0]
+
+    # Did the leader send anything this round?  Heartbeats (every
+    # heartbeat_tick), the election noop, or workload appends.
+    lead_beat = jnp.take_along_axis(
+        want_heartbeat | is_winner, lidx[:, None], axis=1
+    )[:, 0]
+    sent = has_leader & (lead_beat | (n_app > 0) | winner_exists)
+
+    # Peers that sync to the leader this round: alive, reachable terms
+    # (term <= leader's — higher-term peers ignore), not the leader itself.
+    sync = (
+        sent[:, None]
+        & alive
+        & (term_c <= lead_term[:, None])
+        & ~is_acting_leader
+    )
+    term_bumped = sync & (term_c < lead_term[:, None])
+    term_d = jnp.where(sync, lead_term[:, None], term_c)
+    state_d = jnp.where(sync, ROLE_FOLLOWER, state_c)
+    vote_d = jnp.where(term_bumped, 0, vote_c)
+    leader_d = jnp.where(sync, lidx[:, None] + 1, leader_c)
+    ee = jnp.where(sync, 0, ee)
+    rt = jnp.where(term_bumped, draw(term_d), rt)
+    # Followers adopt the leader's log wholesale (prefix property).
+    new_last_index = jnp.where(sync, lead_last[:, None], new_last_index)
+    new_last_term = jnp.where(sync, lead_last_term[:, None], new_last_term)
+
+    # Leader's matched view: reset on election, then acks from every synced
+    # peer + its own persisted tail.
+    matched = jnp.where(winner_exists[:, None], 0, st.matched)
+    matched = jnp.where(sync | is_acting_leader, new_last_index, matched)
+
+    # Quorum commit, gated on the entry being from the leader's own term
+    # (raft_log.maybe_commit's term check; reference: raft_log.rs:487-499 —
+    # mci >= term_start_index iff term(mci) == lead_term, by log monotonicity).
+    mci = kernels.committed_index(matched, st.voter_mask)
+    commit_ok = has_leader & (mci >= term_start) & (mci < kernels.INF)
+    lead_commit_old = jnp.take_along_axis(st.commit, lidx[:, None], axis=1)[:, 0]
+    lead_commit = jnp.where(
+        commit_ok, jnp.maximum(lead_commit_old, mci), lead_commit_old
+    )
+    commit = jnp.where(is_acting_leader, lead_commit[:, None], st.commit)
+    # Synced followers learn min(leader commit, their last) = leader commit.
+    commit = jnp.where(sync, lead_commit[:, None], commit)
+
+    return SimState(
+        term=term_d,
+        state=state_d,
+        vote=vote_d,
+        leader_id=leader_d,
+        election_elapsed=ee,
+        heartbeat_elapsed=hb,
+        randomized_timeout=rt,
+        last_index=new_last_index,
+        last_term=new_last_term,
+        commit=commit,
+        matched=matched,
+        term_start_index=term_start,
+        voter_mask=st.voter_mask,
+    )
+
+
+class ClusterSim:
+    """Convenience wrapper: jitted step + host-friendly runners."""
+
+    def __init__(self, cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None):
+        self.cfg = cfg
+        self.state = init_state(cfg, voter_mask)
+        self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
+
+    def run_round(self, crashed=None, append_n=None) -> SimState:
+        G, P = self.cfg.n_groups, self.cfg.n_peers
+        if crashed is None:
+            crashed = jnp.zeros((G, P), bool)
+        if append_n is None:
+            append_n = jnp.zeros((G,), jnp.int32)
+        self.state = self._step(self.state, crashed, append_n)
+        return self.state
+
+    def run(self, rounds: int, crashed=None, append_n=None) -> SimState:
+        for _ in range(rounds):
+            self.run_round(crashed, append_n)
+        return self.state

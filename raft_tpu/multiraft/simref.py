@@ -1,0 +1,112 @@
+"""ScalarCluster: the lockstep parity oracle for ClusterSim.
+
+Runs G groups × P real scalar `Raft` instances through the harness Network's
+persist-before-send pump, one protocol round at a time, with the same
+(node, term)-keyed deterministic timeouts as the device sim.  A round is:
+tick every peer (in peer order) → pump to quiescence → propose the round's
+append workload at the acting leader → pump.
+
+Commit-index parity between this and ClusterSim on identical crash/append
+schedules is THE correctness claim of the batched backend (BASELINE.json's
+"bit-identical commit indices").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..eraftpb import ConfState, Entry, Message, MessageType
+from ..raft import StateRole
+from ..raft_log import NO_LIMIT
+from ..storage import MemStorage
+from ..harness import Interface, Network
+
+
+class ScalarCluster:
+    def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
+                 heartbeat_tick: int = 1):
+        self.n_groups = n_groups
+        self.n_peers = n_peers
+        self.networks: List[Network] = []
+        for g in range(n_groups):
+            peers: List[Optional[Interface]] = [None] * n_peers
+            config = Config(
+                election_tick=election_tick,
+                heartbeat_tick=heartbeat_tick,
+                max_size_per_msg=NO_LIMIT,
+                max_inflight_msgs=1 << 20,  # effectively unbounded window
+                timeout_seed=g,
+            )
+            self.networks.append(Network.new_with_config(peers, config))
+
+    def _apply_crash_mask(self, net: Network, crashed_row: Sequence[bool]) -> None:
+        net.recover()
+        for p, c in enumerate(crashed_row):
+            if c:
+                net.isolate(p + 1)
+
+    def round(self, crashed: Optional[np.ndarray] = None,
+              append_n: Optional[np.ndarray] = None) -> None:
+        """One lockstep protocol round across all groups."""
+        if crashed is None:
+            crashed = np.zeros((self.n_groups, self.n_peers), dtype=bool)
+        if append_n is None:
+            append_n = np.zeros((self.n_groups,), dtype=np.int64)
+        for g, net in enumerate(self.networks):
+            self._apply_crash_mask(net, crashed[g])
+            # Tick every peer in peer order, collecting outbound messages
+            # with the pump's persist-before-send discipline.
+            initial: List[Message] = []
+            for p in range(1, self.n_peers + 1):
+                peer = net.peers[p]
+                peer.raft.tick()
+                peer.persist()
+                initial.extend(net.filter(peer.read_messages()))
+            net.send(initial)
+            # Propose the append workload at the acting leader (the alive
+            # leader with the highest term).
+            n = int(append_n[g])
+            if n > 0:
+                lead = self.acting_leader(g, crashed[g])
+                if lead is not None:
+                    ents = [Entry(data=b"x") for _ in range(n)]
+                    net.send([
+                        Message(
+                            msg_type=MessageType.MsgPropose,
+                            from_=lead,
+                            to=lead,
+                            entries=ents,
+                        )
+                    ])
+
+    def acting_leader(self, g: int, crashed_row: Sequence[bool]) -> Optional[int]:
+        best = None
+        best_term = -1
+        for p in range(1, self.n_peers + 1):
+            if crashed_row[p - 1]:
+                continue
+            r = self.networks[g].peers[p].raft
+            if r.state == StateRole.Leader and r.term > best_term:
+                best, best_term = p, r.term
+        return best
+
+    # --- state extraction for parity comparison ---
+
+    def snapshot(self) -> dict:
+        G, P = self.n_groups, self.n_peers
+        out = {
+            k: np.zeros((G, P), dtype=np.int64)
+            for k in ("term", "state", "commit", "last_index", "last_term")
+        }
+        for g in range(G):
+            for p in range(P):
+                r = self.networks[g].peers[p + 1].raft
+                out["term"][g, p] = r.term
+                out["state"][g, p] = r.state
+                out["commit"][g, p] = r.raft_log.committed
+                out["last_index"][g, p] = r.raft_log.last_index()
+                out["last_term"][g, p] = r.raft_log.last_term()
+        return out

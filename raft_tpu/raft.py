@@ -177,8 +177,8 @@ class Raft:
         self.priority = c.priority
         self.uncommitted_state = UncommittedState(c.max_uncommitted_size)
         self.max_committed_size_per_ready = c.max_committed_size_per_ready
-        # Counter-based timeout PRNG epoch (see util.deterministic_timeout).
-        self._timeout_epoch = 0
+        # Counter-based timeout PRNG key (see util.deterministic_timeout).
+        self._timeout_key = c.timeout_seed * (1 << 16) + c.id
 
         self.prs = ProgressTracker(c.max_inflight_msgs)
         self.msgs: List[Message] = []
@@ -1332,11 +1332,11 @@ class Raft:
     def reset_randomized_election_timeout(self) -> None:
         """Counter-based deterministic replacement for the reference's
         thread_rng (reference: raft.rs:2744-2756): both the scalar and the
-        TPU backends derive the timeout from (id, epoch) via SplitMix64."""
-        self._timeout_epoch += 1
+        TPU backends derive the timeout from (node_key, term) with the same
+        32-bit mixer, so they draw identical values."""
         self.randomized_election_timeout = deterministic_timeout(
-            self.id,
-            self._timeout_epoch,
+            self._timeout_key,
+            self.term,
             self.min_election_timeout,
             self.max_election_timeout,
         )

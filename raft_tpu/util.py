@@ -53,26 +53,40 @@ def is_continuous_ents(ents_a: Sequence[Entry], ents_b: Sequence[Entry]) -> bool
     return True
 
 
-_U64 = (1 << 64) - 1
+_U32 = (1 << 32) - 1
 
 
-def split_mix64(x: int) -> int:
-    """SplitMix64 mixing function — the counter-based PRNG both backends use
-    for randomized election timeouts, so the scalar oracle and the batched TPU
-    kernel draw IDENTICAL timeouts for the same (node, epoch) key.
+def mix32(x: int) -> int:
+    """32-bit murmur3-finalizer mix — the counter-based PRNG both backends
+    use for randomized election timeouts, so the scalar oracle and the
+    batched TPU kernel (which runs without x64) draw IDENTICAL timeouts for
+    the same (node, epoch) key.
 
     Replaces the reference's `rand::thread_rng().gen_range`
     (reference: raft.rs:2744-2756); determinism here is what makes
     scalar-vs-TPU parity testable (SURVEY.md §7 hard-part 4).
     """
-    x = (x + 0x9E3779B97F4A7C15) & _U64
-    z = x
-    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
-    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
-    return z ^ (z >> 31)
+    x &= _U32
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & _U32
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & _U32
+    x ^= x >> 16
+    return x
 
 
-def deterministic_timeout(node_id: int, epoch: int, lo: int, hi: int) -> int:
-    """Randomized election timeout in [lo, hi) keyed by (node_id, epoch)."""
+def deterministic_timeout(node_key: int, term: int, lo: int, hi: int) -> int:
+    """Randomized election timeout in [lo, hi) keyed by (node_key, term).
+
+    `node_key` identifies the node globally: for a standalone Raft it is the
+    node id; for batched groups it is `group_seed * 2**16 + id` so every
+    (group, peer) draws an independent stream (see Config.timeout_seed).
+
+    Keying by *term* (not by a reset-call counter) is deliberate: any value
+    in [lo, hi) is a legal Raft timeout, same-term redraws are idempotent,
+    and campaigning always bumps the term, so successive elections still get
+    fresh draws — while the scalar core and the batched device kernel agree
+    without having to mirror every reset() call site.
+    """
     assert hi > lo
-    return lo + split_mix64((node_id << 32) ^ epoch) % (hi - lo)
+    return lo + mix32((node_key * 0x9E3779B1 + term) & _U32) % (hi - lo)

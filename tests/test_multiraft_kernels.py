@@ -1,0 +1,175 @@
+"""Kernel-vs-scalar-oracle parity: the batched jnp kernels must agree with
+the scalar quorum/tracker math bit-for-bit on identical inputs (SURVEY.md §7
+phase 4 validation: same inputs as the quorum testdata, compared as ints)."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.quorum import AckIndexer, Index, JointConfig, MajorityConfig, U64_MAX, VoteResult
+from raft_tpu.multiraft import kernels
+from raft_tpu.util import deterministic_timeout
+
+
+P = 7  # padded peer width
+
+
+def make_case(rng):
+    n_voters = rng.randint(1, P)
+    voters = rng.sample(range(P), n_voters)
+    mask = np.zeros(P, dtype=bool)
+    mask[voters] = True
+    matched = np.array([rng.randint(0, 100) for _ in range(P)], dtype=np.int32)
+    return mask, matched
+
+
+def scalar_committed(mask, matched, groups=None, use_gc=False):
+    voters = [i + 1 for i in range(P) if mask[i]]
+    l = AckIndexer(
+        {
+            i + 1: Index(
+                index=int(matched[i]),
+                group_id=int(groups[i]) if groups is not None else 0,
+            )
+            for i in range(P)
+        }
+    )
+    idx, flag = MajorityConfig(voters).committed_index(use_gc, l)
+    return idx, flag
+
+
+def test_committed_index_parity_randomized():
+    rng = random.Random(7)
+    masks, matcheds, want = [], [], []
+    for _ in range(300):
+        mask, matched = make_case(rng)
+        masks.append(mask)
+        matcheds.append(matched)
+        want.append(scalar_committed(mask, matched)[0])
+    got = kernels.committed_index(
+        jnp.asarray(np.stack(matcheds)), jnp.asarray(np.stack(masks))
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
+
+
+def test_committed_index_empty_config_is_inf():
+    got = kernels.committed_index(
+        jnp.zeros((1, P), jnp.int32), jnp.zeros((1, P), bool)
+    )
+    assert int(got[0]) == 2**31 - 1
+
+
+def test_joint_committed_index_parity():
+    rng = random.Random(8)
+    inc, out, matcheds, want = [], [], [], []
+    for _ in range(300):
+        imask, matched = make_case(rng)
+        n_out = rng.randint(0, P)
+        omask = np.zeros(P, dtype=bool)
+        omask[rng.sample(range(P), n_out)] = True
+        inc.append(imask)
+        out.append(omask)
+        matcheds.append(matched)
+        voters_i = [i + 1 for i in range(P) if imask[i]]
+        voters_o = [i + 1 for i in range(P) if omask[i]]
+        l = AckIndexer({i + 1: Index(index=int(matched[i])) for i in range(P)})
+        joint = JointConfig.from_majorities(
+            MajorityConfig(voters_i), MajorityConfig(voters_o)
+        )
+        w = joint.committed_index(False, l)[0]
+        want.append(min(w, 2**31 - 1))
+    got = kernels.joint_committed_index(
+        jnp.asarray(np.stack(matcheds)),
+        jnp.asarray(np.stack(inc)),
+        jnp.asarray(np.stack(out)),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
+
+
+def test_committed_index_grouped_parity():
+    rng = random.Random(9)
+    masks, matcheds, groups, want_idx, want_flag = [], [], [], [], []
+    for _ in range(400):
+        mask, matched = make_case(rng)
+        g = np.array([rng.randint(0, 3) for _ in range(P)], dtype=np.int32)
+        masks.append(mask)
+        matcheds.append(matched)
+        groups.append(g)
+        wi, wf = scalar_committed(mask, matched, groups=g, use_gc=True)
+        want_idx.append(min(wi, 2**31 - 1))
+        want_flag.append(wf)
+    got_idx, got_flag = kernels.committed_index_grouped(
+        jnp.asarray(np.stack(matcheds)),
+        jnp.asarray(np.stack(groups)),
+        jnp.asarray(np.stack(masks)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_idx), np.asarray(want_idx, dtype=np.int32)
+    )
+    np.testing.assert_array_equal(np.asarray(got_flag), np.asarray(want_flag))
+
+
+def test_vote_result_parity():
+    rng = random.Random(10)
+    masks, gr, rj, want = [], [], [], []
+    for _ in range(300):
+        mask, _ = make_case(rng)
+        granted = np.zeros(P, dtype=bool)
+        rejected = np.zeros(P, dtype=bool)
+        votes = {}
+        for i in range(P):
+            r = rng.random()
+            if r < 0.4:
+                granted[i] = True
+                votes[i + 1] = True
+            elif r < 0.7:
+                rejected[i] = True
+                votes[i + 1] = False
+        masks.append(mask)
+        gr.append(granted)
+        rj.append(rejected)
+        voters = [i + 1 for i in range(P) if mask[i]]
+        want.append(int(MajorityConfig(voters).vote_result(lambda id: votes.get(id))))
+    got = kernels.vote_result(
+        jnp.asarray(np.stack(gr)), jnp.asarray(np.stack(rj)), jnp.asarray(np.stack(masks))
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
+
+
+def test_timeout_draw_parity():
+    keys = np.arange(1, 257, dtype=np.uint32)
+    epochs = np.arange(1, 257, dtype=np.uint32)
+    lo, hi = 10, 20
+    got = kernels.timeout_draw(
+        jnp.asarray(keys),
+        jnp.asarray(epochs),
+        jnp.full(keys.shape, lo, jnp.int32),
+        jnp.full(keys.shape, hi, jnp.int32),
+    )
+    want = [deterministic_timeout(int(k), int(e), lo, hi) for k, e in zip(keys, epochs)]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
+
+
+def test_tick_kernel_matches_scalar_counters():
+    """Tick a batch with mixed roles and verify the counter/mask semantics
+    against hand-computed expectations (reference: raft.rs:1024-1079)."""
+    state = jnp.asarray([0, 2, 0, 2, 1], jnp.int32)  # F, L, F, L, C
+    ee = jnp.asarray([8, 9, 3, 2, 8], jnp.int32)
+    hb = jnp.asarray([0, 1, 0, 0, 0], jnp.int32)
+    rt = jnp.asarray([9, 99, 99, 99, 9], jnp.int32)
+    promotable = jnp.asarray([True, True, True, True, False])
+    ee2, hb2, campaign, heartbeat, checkq = kernels.tick_kernel(
+        state, ee, hb, rt, promotable, election_timeout=10, heartbeat_timeout=2
+    )
+    # follower 0: 8->9 >= rt 9, promotable -> campaign, ee reset
+    assert bool(campaign[0]) and int(ee2[0]) == 0
+    # leader 1: ee 9->10 >= 10 -> check quorum, ee reset; hb 1->2 >= 2 -> beat
+    assert bool(checkq[1]) and bool(heartbeat[1])
+    assert int(ee2[1]) == 0 and int(hb2[1]) == 0
+    # follower 2: no timeout
+    assert not bool(campaign[2]) and int(ee2[2]) == 4
+    # leader 3: no timeouts, hb 0->1 < 2
+    assert not bool(heartbeat[3]) and int(hb2[3]) == 1
+    # candidate 4: timeout but not promotable
+    assert not bool(campaign[4]) and int(ee2[4]) == 9

@@ -1,0 +1,128 @@
+"""Scalar-vs-device closed-loop parity: the BASELINE.json correctness claim.
+
+Drives the SAME schedule (crash masks + append workloads) through
+ScalarCluster (real scalar Raft state machines + harness pump) and
+ClusterSim (the batched device kernels) and asserts per-round equality of
+every peer's (term, state, commit, last_index, last_term)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
+
+
+FIELDS = ("term", "state", "commit", "last_index", "last_term")
+
+
+def device_snapshot(state):
+    return {
+        "term": np.asarray(state.term, dtype=np.int64),
+        "state": np.asarray(state.state, dtype=np.int64),
+        "commit": np.asarray(state.commit, dtype=np.int64),
+        "last_index": np.asarray(state.last_index, dtype=np.int64),
+        "last_term": np.asarray(state.last_term, dtype=np.int64),
+    }
+
+
+def run_parity(G, P, rounds, schedule, seed_note=""):
+    """schedule(round) -> (crashed[G,P] bool, append[G] int)"""
+    scalar = ScalarCluster(G, P)
+    sim = ClusterSim(SimConfig(n_groups=G, n_peers=P))
+    for r in range(rounds):
+        crashed, append = schedule(r)
+        scalar.round(crashed, append)
+        sim.run_round(jnp.asarray(crashed), jnp.asarray(append, dtype=jnp.int32))
+        want = scalar.snapshot()
+        got = device_snapshot(sim.state)
+        for f in FIELDS:
+            if not np.array_equal(want[f], got[f]):
+                bad = np.argwhere(want[f] != got[f])
+                g, p = bad[0]
+                raise AssertionError(
+                    f"{seed_note} round {r}: field {f} mismatch at group {g} "
+                    f"peer {p}: scalar={want[f][g, p]} device={got[f][g, p]}\n"
+                    f"scalar row: "
+                    f"{ {k: v[g].tolist() for k, v in want.items()} }\n"
+                    f"device row: "
+                    f"{ {k: v[g].tolist() for k, v in got.items()} }"
+                )
+
+
+def test_parity_quiet_elections():
+    """No crashes, no appends: initial election storm then stability."""
+    G, P = 8, 3
+
+    def schedule(r):
+        return np.zeros((G, P), bool), np.zeros(G, np.int64)
+
+    run_parity(G, P, 40, schedule)
+
+
+def test_parity_steady_appends():
+    """Uniform append workload after elections settle (BASELINE config 2)."""
+    G, P = 8, 3
+
+    def schedule(r):
+        return np.zeros((G, P), bool), np.full(G, 2, np.int64)
+
+    run_parity(G, P, 40, schedule)
+
+
+def test_parity_5peer_appends():
+    G, P = 6, 5
+
+    def schedule(r):
+        appends = np.array([r % 3 == 0] * G, np.int64) * (1 + r % 2)
+        return np.zeros((G, P), bool), appends
+
+    run_parity(G, P, 50, schedule)
+
+
+def test_parity_leader_crash_and_recovery():
+    """Crash whoever leads group 0 for a stretch, then recover."""
+    G, P = 4, 3
+    sim_crash = np.zeros((G, P), bool)
+    # Deterministic plan: crash peer 0 of every group for rounds 25..55,
+    # crash peer 1 for rounds 70..100.
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if 25 <= r < 55:
+            crashed[:, 0] = True
+        if 70 <= r < 100:
+            crashed[:, 1] = True
+        return crashed, np.full(G, int(r % 2), np.int64)
+
+    run_parity(G, P, 120, schedule)
+
+
+def test_parity_random_schedules():
+    """Randomized crash/append schedules across many seeds (election storms,
+    staggered recoveries, minority and majority outages)."""
+    G, P = 4, 3
+    for seed in range(6):
+        rng = np.random.RandomState(seed)
+        # Persistent crash state flipped with small probability per round.
+        crashed = np.zeros((G, P), bool)
+
+        def schedule(r, rng=rng, crashed=crashed):
+            for g in range(G):
+                for p in range(P):
+                    if rng.rand() < 0.02:
+                        crashed[g, p] = not crashed[g, p]
+            append = rng.randint(0, 3, size=G).astype(np.int64)
+            return crashed.copy(), append
+
+        run_parity(G, P, 80, schedule, seed_note=f"seed {seed}")
+
+
+def test_parity_majority_crash_stalls_commit():
+    G, P = 2, 5
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if 25 <= r < 90:
+            crashed[:, :3] = True  # majority down
+        return crashed, np.full(G, 1, np.int64)
+
+    run_parity(G, P, 110, schedule)
