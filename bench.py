@@ -1,0 +1,100 @@
+"""Benchmark: Raft ticks/sec/chip at 100k groups (BASELINE.json config 3
+shape: 100k groups × 5 peers, steady append load).
+
+Runs the fused MultiRaft round on the default JAX device (the real TPU under
+the driver) with a lax.scan-batched dispatch, anchors against the scalar
+CPU RawNode loop (the same protocol through raft_tpu.harness at small G,
+scaled per-group), and prints ONE JSON line:
+
+  {"metric": ..., "value": ..., "unit": "ticks/sec", "vs_baseline": ...}
+
+vs_baseline = device ticks/sec ÷ scalar-core ticks/sec (the reference
+publishes no numbers — BASELINE.md — so the anchor is measured in-process).
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+G = 100_000
+P = 5
+ROUNDS_PER_SCAN = 50
+SCANS = 4
+ANCHOR_GROUPS = 32
+ANCHOR_ROUNDS = 30
+
+
+def bench_device() -> float:
+    from raft_tpu.multiraft import sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    cfg = SimConfig(n_groups=G, n_peers=P)
+    state = sim.init_state(cfg)
+    crashed = jnp.zeros((G, P), bool)
+    append = jnp.ones((G,), jnp.int32)
+
+    step = functools.partial(sim.step, cfg)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_round(st):
+        def body(s, _):
+            return step(s, crashed, append), ()
+
+        st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN)
+        return st
+
+    # Warm up: compile + let elections settle into steady state.
+    state = multi_round(state)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(SCANS):
+        state = multi_round(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    ticks = G * ROUNDS_PER_SCAN * SCANS
+    # Sanity: the protocol is actually running (leaders + commits advance).
+    commit_min = int(jnp.min(jnp.max(state.commit, axis=-1)))
+    assert commit_min > 0, "bench sanity: no commits on device"
+    return ticks / dt
+
+
+def bench_scalar_anchor() -> float:
+    from raft_tpu.multiraft.simref import ScalarCluster
+
+    cluster = ScalarCluster(ANCHOR_GROUPS, P)
+    append = np.ones((ANCHOR_GROUPS,), dtype=np.int64)
+    # Let elections settle before timing (same steady state as the device).
+    for _ in range(25):
+        cluster.round(None, append)
+    t0 = time.perf_counter()
+    for _ in range(ANCHOR_ROUNDS):
+        cluster.round(None, append)
+    dt = time.perf_counter() - t0
+    return ANCHOR_GROUPS * ANCHOR_ROUNDS / dt
+
+
+def main() -> None:
+    device_tps = bench_device()
+    scalar_tps = bench_scalar_anchor()
+    print(
+        json.dumps(
+            {
+                "metric": "raft_ticks_per_sec_100k_groups_5_peers",
+                "value": round(device_tps, 1),
+                "unit": "ticks/sec",
+                "vs_baseline": round(device_tps / scalar_tps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

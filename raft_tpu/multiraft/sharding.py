@@ -1,0 +1,148 @@
+"""Multi-chip scale-out: shard the group axis over a device mesh.
+
+The MultiRaft batch is embarrassingly parallel across groups — every [G, P]
+plane shards on G ('groups' mesh axis), the peer axis stays local to a chip
+(P <= 8; a group's whole quorum computation is a few lanes of one VPU
+register).  XLA therefore inserts NO collectives in the steady-state step;
+the only cross-chip traffic is the status reduction (leader counts, commit
+mins) which rides ICI via psum/pmin inside shard_map.
+
+This is the direct analog of data parallelism for consensus (SURVEY.md §2
+parallelism checklist item (a)); peer-axis vectorization is item (b); the
+metrics collectives are item (c)'s intra-pod half.  Cross-host real Raft
+traffic (DCN) terminates in the host driver, not here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import sim
+from .kernels import ROLE_LEADER
+from .sim import SimConfig, SimState
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
+    """1-D device mesh over the group axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return jax.make_mesh((len(devices),), (axis,), devices=devices)
+
+
+def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
+    """PartitionSpecs for every SimState field: G sharded, P replicated
+    within the shard (it's the minor axis of the same arrays)."""
+    gp = NamedSharding(mesh, P(axis, None))
+    g = NamedSharding(mesh, P(axis))
+    return SimState(
+        term=gp, state=gp, vote=gp, leader_id=gp,
+        election_elapsed=gp, heartbeat_elapsed=gp, randomized_timeout=gp,
+        last_index=gp, last_term=gp, commit=gp,
+        matched=gp, term_start_index=g, voter_mask=gp,
+    )
+
+
+def shard_state(state: SimState, mesh: Mesh, axis: str = "groups") -> SimState:
+    shardings = state_sharding(mesh, axis)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def sharded_step(
+    cfg: SimConfig, mesh: Mesh, axis: str = "groups", donate: bool = True
+):
+    """Compile the full sim step under group-axis sharding.
+
+    Node keys must stay GLOBAL group ids (parity with the scalar oracle), so
+    the step runs under jit-with-shardings rather than shard_map: XLA sees
+    the global shapes, the iota node keys stay global, and every op
+    partitions trivially along G.
+    """
+    shardings = state_sharding(mesh, axis)
+    crashed_sh = NamedSharding(mesh, P(axis, None))
+    append_sh = NamedSharding(mesh, P(axis))
+    return jax.jit(
+        functools.partial(sim.step, cfg),
+        in_shardings=(shardings, crashed_sh, append_sh),
+        out_shardings=shardings,
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
+    """MultiRaftStatus reduction (SURVEY.md §5.5): per-shard partial
+    aggregates combined across chips with XLA collectives over ICI.
+
+    Returns a jitted fn: SimState -> dict of scalars
+      n_leaders:   groups currently led
+      min_commit:  minimum commit index across groups
+      max_term:    maximum term across groups
+      total_commit: sum of per-group leader commit indices
+    """
+    from jax import shard_map
+
+    state_specs = jax.tree.map(
+        lambda s: s.spec, state_sharding(mesh, axis)
+    )
+
+    def local(st: SimState):
+        is_leader = st.state == ROLE_LEADER
+        has_leader = jnp.any(is_leader, axis=-1)
+        lead_commit = jnp.max(jnp.where(is_leader, st.commit, 0), axis=-1)
+        group_commit = jnp.max(st.commit, axis=-1)
+        n_leaders = jax.lax.psum(
+            jnp.sum(has_leader.astype(jnp.int32)), axis_name=axis
+        )
+        min_commit = jax.lax.pmin(jnp.min(group_commit), axis_name=axis)
+        max_term = jax.lax.pmax(jnp.max(st.term), axis_name=axis)
+        total_commit = jax.lax.psum(
+            jnp.sum(lead_commit.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)),
+            axis_name=axis,
+        )
+        return {
+            "n_leaders": n_leaders,
+            "min_commit": min_commit,
+            "max_term": max_term,
+            "total_commit": total_commit,
+        }
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(state_specs,),
+        out_specs={
+            "n_leaders": P(),
+            "min_commit": P(),
+            "max_term": P(),
+            "total_commit": P(),
+        },
+    )
+    return jax.jit(fn)
+
+
+def run_sharded(
+    cfg: SimConfig,
+    mesh: Mesh,
+    rounds: int,
+    axis: str = "groups",
+) -> Tuple[SimState, dict]:
+    """Initialize, shard, and advance `rounds` steps on the mesh; returns
+    (final_state, global status dict)."""
+    st = shard_state(sim.init_state(cfg), mesh, axis)
+    step_fn = sharded_step(cfg, mesh, axis)
+    crashed = jax.device_put(
+        jnp.zeros((cfg.n_groups, cfg.n_peers), bool),
+        NamedSharding(mesh, P(axis, None)),
+    )
+    append = jax.device_put(
+        jnp.ones((cfg.n_groups,), jnp.int32), NamedSharding(mesh, P(axis))
+    )
+    for _ in range(rounds):
+        st = step_fn(st, crashed, append)
+    status = global_status(cfg, mesh, axis)(st)
+    return st, jax.tree.map(lambda x: int(x), status)
