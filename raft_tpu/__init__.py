@@ -38,6 +38,7 @@ from .errors import (
 )
 from .eraftpb import (
     ConfChange,
+    ConfChangeV2,
     ConfChangeSingle,
     ConfChangeTransition,
     ConfChangeType,
@@ -89,6 +90,7 @@ __version__ = "0.1.0"
 __all__ = [
     "Config",
     "ConfChange",
+    "ConfChangeV2",
     "ConfChangeSingle",
     "ConfChangeTransition",
     "ConfChangeType",

@@ -1,0 +1,610 @@
+"""Raft-paper conformance suite (ported behaviors from reference:
+harness/tests/integration_cases/test_raft_paper.rs — the tests are named for
+the paper sections they check)."""
+
+import pytest
+
+from raft_tpu import (
+    Config,
+    Entry,
+    MemStorage,
+    Message,
+    MessageType,
+    StateRole,
+)
+from raft_tpu.harness import Interface, Network
+from raft_tpu.harness.interface import NOP_STEPPER
+
+from test_util import (
+    SOME_DATA,
+    empty_entry,
+    ltoa,
+    new_entry,
+    new_hard_state,
+    new_message,
+    new_message_with_entries,
+    new_storage,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+)
+
+
+def commit_noop_entry(r: Interface, s: MemStorage):
+    """reference: test_raft_paper.rs:24-46"""
+    assert r.state == StateRole.Leader
+    r.raft.bcast_append()
+    for m in r.read_messages():
+        assert m.msg_type == MessageType.MsgAppend
+        assert len(m.entries) == 1
+        assert not m.entries[0].data
+        r.step(accept_and_reply(m))
+    r.read_messages()
+    unstable = list(r.raft_log.unstable_entries())
+    if unstable:
+        e = unstable[-1]
+        last_idx, last_term = e.index, e.term
+        r.raft_log.stable_entries(last_idx, last_term)
+        with s.wl() as core:
+            core.append(unstable)
+        r.raft.on_persist_entries(last_idx, last_term)
+        r.raft.commit_apply(r.raft_log.committed)
+
+
+def accept_and_reply(m: Message) -> Message:
+    """reference: test_raft_paper.rs:48-55"""
+    assert m.msg_type == MessageType.MsgAppend
+    reply = new_message(m.to, m.from_, MessageType.MsgAppendResponse)
+    reply.term = m.term
+    reply.index = m.index + len(m.entries)
+    return reply
+
+
+@pytest.mark.parametrize("state", [StateRole.Follower, StateRole.Candidate, StateRole.Leader])
+def test_update_term_from_message(state):
+    """§5.1: discovering a larger term reverts any role to follower."""
+    r = new_test_raft(1, [1, 2, 3], 10, 1)
+    if state == StateRole.Follower:
+        r.raft.become_follower(1, 2)
+    elif state == StateRole.Candidate:
+        r.raft.become_candidate()
+    else:
+        r.raft.become_candidate()
+        r.raft.become_leader()
+
+    m = new_message(0, 0, MessageType.MsgAppend)
+    m.term = 2
+    r.step(m)
+
+    assert r.term == 2
+    assert r.state == StateRole.Follower
+
+
+def test_start_as_follower():
+    """§5.2: servers start as followers."""
+    r = new_test_raft(1, [1, 2, 3], 10, 1)
+    assert r.state == StateRole.Follower
+
+
+def test_leader_bcast_beat():
+    """§5.2: leaders heartbeat on the heartbeat tick."""
+    hi = 1
+    r = new_test_raft(1, [1, 2, 3], 10, hi)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    for i in range(10):
+        assert r.raft.append_entry([empty_entry(0, i + 1)])
+    for _ in range(hi):
+        r.raft.tick()
+
+    msgs = sorted(r.read_messages(), key=lambda m: m.to)
+    assert [(m.to, m.msg_type, m.term, m.commit) for m in msgs] == [
+        (2, MessageType.MsgHeartbeat, 1, 0),
+        (3, MessageType.MsgHeartbeat, 1, 0),
+    ]
+
+
+@pytest.mark.parametrize("state", [StateRole.Follower, StateRole.Candidate])
+def test_nonleader_start_election(state):
+    """§5.2: followers and candidates campaign after the election timeout."""
+    et = 10
+    r = new_test_raft(1, [1, 2, 3], et, 1)
+    if state == StateRole.Follower:
+        r.raft.become_follower(1, 2)
+    else:
+        r.raft.become_candidate()
+
+    for _ in range(1, 2 * et):
+        r.raft.tick()
+
+    assert r.term == 2
+    assert r.state == StateRole.Candidate
+    assert r.raft.prs.votes[r.raft.id]
+    msgs = sorted(r.read_messages(), key=lambda m: m.to)
+    assert [(m.to, m.msg_type, m.term) for m in msgs] == [
+        (2, MessageType.MsgRequestVote, 2),
+        (3, MessageType.MsgRequestVote, 2),
+    ]
+
+
+def test_leader_election_in_one_round_rpc():
+    """§5.2: win/lose/pending outcomes of one RequestVote round."""
+    tests = [
+        (1, {}, StateRole.Leader),
+        (3, {2: True, 3: True}, StateRole.Leader),
+        (3, {2: True}, StateRole.Leader),
+        (5, {2: True, 3: True, 4: True, 5: True}, StateRole.Leader),
+        (5, {2: True, 3: True, 4: True}, StateRole.Leader),
+        (5, {2: True, 3: True}, StateRole.Leader),
+        (3, {2: False, 3: False}, StateRole.Follower),
+        (5, {2: False, 3: False, 4: False, 5: False}, StateRole.Follower),
+        (5, {2: True, 3: False, 4: False, 5: False}, StateRole.Follower),
+        (3, {}, StateRole.Candidate),
+        (5, {2: True}, StateRole.Candidate),
+        (5, {2: False, 3: False}, StateRole.Candidate),
+        (5, {}, StateRole.Candidate),
+    ]
+    for i, (size, votes, state) in enumerate(tests):
+        r = new_test_raft(1, list(range(1, size + 1)), 10, 1)
+        r.step(new_message(1, 1, MessageType.MsgHup))
+        for id, vote in votes.items():
+            m = new_message(id, 1, MessageType.MsgRequestVoteResponse)
+            m.term = r.term
+            m.reject = not vote
+            r.step(m)
+        assert r.state == state, f"#{i}"
+        assert r.term == 1, f"#{i}"
+
+
+def test_follower_vote():
+    """§5.2: at most one vote per term, first come first served."""
+    tests = [
+        (0, 1, False),
+        (0, 2, False),
+        (1, 1, False),
+        (2, 2, False),
+        (1, 2, True),
+        (2, 1, True),
+    ]
+    for i, (vote, nvote, wreject) in enumerate(tests):
+        r = new_test_raft(1, [1, 2, 3], 10, 1)
+        r.raft.load_state(new_hard_state(1, vote, 0))
+
+        m = new_message(nvote, 1, MessageType.MsgRequestVote)
+        m.term = 1
+        r.step(m)
+
+        msgs = r.read_messages()
+        assert len(msgs) == 1, f"#{i}"
+        assert msgs[0].msg_type == MessageType.MsgRequestVoteResponse, f"#{i}"
+        assert msgs[0].to == nvote and msgs[0].term == 1, f"#{i}"
+        assert msgs[0].reject == wreject, f"#{i}"
+
+
+def test_candidate_fallback():
+    """§5.2: a candidate recognizes a legitimate leader's append."""
+    for i, term in enumerate([2, 3]):
+        r = new_test_raft(1, [1, 2, 3], 10, 1)
+        r.step(new_message(1, 1, MessageType.MsgHup))
+        assert r.state == StateRole.Candidate
+
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = term
+        r.step(m)
+
+        assert r.state == StateRole.Follower, f"#{i}"
+        assert r.term == term, f"#{i}"
+
+
+@pytest.mark.parametrize("state", [StateRole.Follower, StateRole.Candidate])
+def test_non_leader_election_timeout_randomized(state):
+    """§5.2: election timeouts are drawn from [et, 2et)."""
+    et = 10
+    r = new_test_raft(1, [1, 2, 3], et, 1)
+    timeouts = set()
+    for _ in range(50 * et):
+        term = r.term
+        if state == StateRole.Follower:
+            r.raft.become_follower(term + 1, 2)
+        else:
+            r.raft.become_candidate()
+        time = 0
+        while not r.read_messages():
+            r.raft.tick()
+            time += 1
+        timeouts.add(time)
+    # Draws live in [et, 2et) and the counter PRNG covers most of the range.
+    assert all(et <= t <= 2 * et - 1 for t in timeouts)
+    assert len(timeouts) >= et - 2
+
+
+@pytest.mark.parametrize("state", [StateRole.Follower, StateRole.Candidate])
+def test_nonleaders_election_timeout_nonconflict(state):
+    """§5.2: randomized timeouts make simultaneous campaigns rare."""
+    et = 10
+    size = 5
+    ids = list(range(1, size + 1))
+    rs = [new_test_raft(id, ids, et, 1) for id in ids]
+    conflicts = 0
+    rounds = 200
+    for _ in range(rounds):
+        for r in rs:
+            term = r.term
+            if state == StateRole.Follower:
+                r.raft.become_follower(term + 1, 0)
+            else:
+                r.raft.become_candidate()
+        timeout_num = 0
+        while timeout_num == 0:
+            for r in rs:
+                r.raft.tick()
+                if r.read_messages():
+                    timeout_num += 1
+        if timeout_num > 1:
+            conflicts += 1
+    assert conflicts / rounds <= 0.3
+
+
+def test_leader_start_replication():
+    """§5.3: proposals append + broadcast with the preceding (index, term)."""
+    s = new_storage()
+    r = new_test_raft(1, [1, 2, 3], 10, 1, s)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    commit_noop_entry(r, s)
+    li = r.raft_log.last_index()
+
+    r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+
+    assert r.raft_log.last_index() == li + 1
+    assert r.raft_log.committed == li
+    msgs = sorted(r.read_messages(), key=lambda m: m.to)
+    for m, to in zip(msgs, [2, 3]):
+        assert m.to == to
+        assert m.msg_type == MessageType.MsgAppend
+        assert (m.index, m.log_term, m.commit) == (li, 1, li)
+        assert [(e.term, e.index, e.data) for e in m.entries] == [(1, li + 1, SOME_DATA)]
+    assert [(e.term, e.index) for e in r.raft_log.unstable_entries()] == [(1, li + 1)]
+
+
+def test_leader_commit_entry():
+    """§5.3: entry commits once replicated to a majority; commit index is
+    propagated."""
+    s = new_storage()
+    r = new_test_raft(1, [1, 2, 3], 10, 1, s)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    commit_noop_entry(r, s)
+    li = r.raft_log.last_index()
+    r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+    r.persist()
+
+    for m in r.read_messages():
+        r.step(accept_and_reply(m))
+
+    assert r.raft_log.committed == li + 1
+    wents = r.raft_log.next_entries(None)
+    assert [(e.term, e.index) for e in wents] == [(1, li + 1)]
+    msgs = sorted(r.read_messages(), key=lambda m: m.to)
+    for i, m in enumerate(msgs):
+        assert m.to == i + 2
+        assert m.msg_type == MessageType.MsgAppend
+        assert m.commit == li + 1
+
+
+def test_leader_acknowledge_commit():
+    """§5.3: commit requires a majority of acks."""
+    tests = [
+        (1, {}, True),
+        (3, {}, False),
+        (3, {2: True}, True),
+        (3, {2: True, 3: True}, True),
+        (5, {}, False),
+        (5, {2: True}, False),
+        (5, {2: True, 3: True}, True),
+        (5, {2: True, 3: True, 4: True}, True),
+        (5, {2: True, 3: True, 4: True, 5: True}, True),
+    ]
+    for i, (size, acceptors, wack) in enumerate(tests):
+        s = new_storage()
+        r = new_test_raft(1, list(range(1, size + 1)), 10, 1, s)
+        r.raft.become_candidate()
+        r.raft.become_leader()
+        commit_noop_entry(r, s)
+        li = r.raft_log.last_index()
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        r.persist()
+
+        for m in r.read_messages():
+            if acceptors.get(m.to):
+                r.step(accept_and_reply(m))
+
+        assert (r.raft_log.committed > li) == wack, f"#{i}"
+
+
+def test_leader_commit_preceding_entries():
+    """§5.3: committing an entry commits all preceding entries."""
+    tests = [
+        [],
+        [empty_entry(2, 1)],
+        [empty_entry(1, 1), empty_entry(2, 2)],
+        [empty_entry(1, 1)],
+    ]
+    for i, tt in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with store.wl() as core:
+            core.append(tt)
+        cfg = new_test_config(1, 10, 1)
+        r = new_test_raft_with_config(cfg, store)
+        r.raft.load_state(new_hard_state(2, 0, 0))
+        r.raft.become_candidate()
+        r.raft.become_leader()
+
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        r.persist()
+
+        for m in r.read_messages():
+            r.step(accept_and_reply(m))
+
+        li = len(tt)
+        want = [(e.term, e.index, e.data) for e in tt] + [
+            (3, li + 1, b""),
+            (3, li + 2, SOME_DATA),
+        ]
+        got = r.raft_log.next_entries(None)
+        assert [(e.term, e.index, e.data) for e in got] == want, f"#{i}"
+
+
+def test_follower_commit_entry():
+    """§5.3: followers apply committed entries in log order."""
+    tests = [
+        ([new_entry(1, 1, SOME_DATA)], 1),
+        ([new_entry(1, 1, SOME_DATA), new_entry(1, 2, b"somedata2")], 2),
+        ([new_entry(1, 1, b"somedata2"), new_entry(1, 2, SOME_DATA)], 2),
+        ([new_entry(1, 1, SOME_DATA), new_entry(1, 2, b"somedata2")], 1),
+    ]
+    for i, (ents, commit) in enumerate(tests):
+        r = new_test_raft(1, [1, 2, 3], 10, 1)
+        r.raft.become_follower(1, 2)
+
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = 1
+        m.commit = commit
+        m.entries = [Entry(term=e.term, index=e.index, data=e.data) for e in ents]
+        r.step(m)
+        r.persist()
+
+        assert r.raft_log.committed == commit, f"#{i}"
+        got = r.raft_log.next_entries(None)
+        want = ents[:commit]
+        assert [(e.term, e.index, e.data) for e in got] == [
+            (e.term, e.index, e.data) for e in want
+        ], f"#{i}"
+
+
+def test_follower_check_msg_append():
+    """§5.3: followers reject appends whose (index, term) they don't have."""
+    ents = [empty_entry(1, 1), empty_entry(2, 2)]
+    tests = [
+        # (term, index, windex, wcommit, wreject, wreject_hint, wlog_term)
+        (0, 0, 1, 1, False, 0, 0),
+        (ents[0].term, ents[0].index, 1, 1, False, 0, 0),
+        (ents[1].term, ents[1].index, 2, 1, False, 0, 0),
+        (ents[0].term, ents[1].index, ents[1].index, 1, True, 1, 1),
+        (ents[1].term + 1, ents[1].index + 1, ents[1].index + 1, 1, True, 2, 2),
+    ]
+    for i, (term, index, windex, wcommit, wreject, whint, wlog_term) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with store.wl() as core:
+            core.append(ents)
+        cfg = new_test_config(1, 10, 1)
+        r = new_test_raft_with_config(cfg, store)
+        r.raft.load_state(new_hard_state(0, 0, 1))
+        r.raft.become_follower(2, 2)
+
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = 2
+        m.log_term = term
+        m.index = index
+        r.step(m)
+
+        msgs = r.read_messages()
+        assert len(msgs) == 1, f"#{i}"
+        got = msgs[0]
+        assert got.msg_type == MessageType.MsgAppendResponse, f"#{i}"
+        assert (got.term, got.index, got.commit) == (2, windex, wcommit), f"#{i}"
+        assert got.reject == wreject, f"#{i}"
+        if wreject:
+            assert got.reject_hint == whint, f"#{i}"
+            assert got.log_term == wlog_term, f"#{i}"
+
+
+def test_follower_append_entries():
+    """§5.3: conflicting suffix is deleted, new entries appended."""
+    tests = [
+        (2, 2, [empty_entry(3, 3)], [(1, 1), (2, 2), (3, 3)], [(3, 3)]),
+        (
+            1, 1,
+            [empty_entry(3, 2), empty_entry(4, 3)],
+            [(1, 1), (3, 2), (4, 3)],
+            [(3, 2), (4, 3)],
+        ),
+        (0, 0, [empty_entry(1, 1)], [(1, 1), (2, 2)], []),
+        (0, 0, [empty_entry(3, 1)], [(3, 1)], [(3, 1)]),
+    ]
+    for i, (index, term, ents, wents, wunstable) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with store.wl() as core:
+            core.append([empty_entry(1, 1), empty_entry(2, 2)])
+        cfg = new_test_config(1, 10, 1)
+        r = new_test_raft_with_config(cfg, store)
+        r.raft.become_follower(2, 2)
+
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = 2
+        m.log_term = term
+        m.index = index
+        m.entries = ents
+        r.step(m)
+
+        assert [(e.term, e.index) for e in r.raft_log.all_entries()] == wents, f"#{i}"
+        assert [
+            (e.term, e.index) for e in r.raft_log.unstable_entries()
+        ] == wunstable, f"#{i}"
+
+
+def test_leader_sync_follower_log():
+    """§5.3 figure 7: the leader brings divergent follower logs into
+    consistency with its own."""
+    ents = [
+        empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+        empty_entry(4, 4), empty_entry(4, 5),
+        empty_entry(5, 6), empty_entry(5, 7),
+        empty_entry(6, 8), empty_entry(6, 9), empty_entry(6, 10),
+    ]
+    term = 8
+    tests = [
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(4, 4), empty_entry(4, 5), empty_entry(5, 6),
+            empty_entry(5, 7), empty_entry(6, 8), empty_entry(6, 9),
+        ],
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(4, 4),
+        ],
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(4, 4), empty_entry(4, 5), empty_entry(5, 6),
+            empty_entry(5, 7), empty_entry(6, 8), empty_entry(6, 9),
+            empty_entry(6, 10), empty_entry(6, 11),
+        ],
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(4, 4), empty_entry(4, 5), empty_entry(5, 6),
+            empty_entry(5, 7), empty_entry(6, 8), empty_entry(6, 9),
+            empty_entry(6, 10), empty_entry(7, 11), empty_entry(7, 12),
+        ],
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(4, 4), empty_entry(4, 5), empty_entry(4, 6),
+            empty_entry(4, 7),
+        ],
+        [
+            empty_entry(1, 1), empty_entry(1, 2), empty_entry(1, 3),
+            empty_entry(2, 4), empty_entry(2, 5), empty_entry(2, 6),
+            empty_entry(3, 7), empty_entry(3, 8), empty_entry(3, 9),
+            empty_entry(3, 10), empty_entry(3, 11),
+        ],
+    ]
+    for i, tt in enumerate(tests):
+        lead_store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with lead_store.wl() as core:
+            core.append(ents)
+        lead = new_test_raft_with_config(new_test_config(1, 10, 1), lead_store)
+        last_index = lead.raft_log.last_index()
+        lead.raft.load_state(new_hard_state(term, 0, last_index))
+
+        f_store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with f_store.wl() as core:
+            core.append(tt)
+        follower = new_test_raft_with_config(new_test_config(2, 10, 1), f_store)
+        follower.raft.load_state(new_hard_state(term - 1, 0, 0))
+
+        # Three-node cluster: node 3 (black hole) provides the third vote.
+        n = Network.new([lead, follower, NOP_STEPPER()])
+        n.send([new_message(1, 1, MessageType.MsgHup)])
+        m = new_message(3, 1, MessageType.MsgRequestVoteResponse)
+        m.term = term + 1
+        n.send([m])
+
+        prop = new_message(1, 1, MessageType.MsgPropose)
+        prop.entries = [Entry()]
+        n.send([prop])
+        assert ltoa(n.peers[1].raft) == ltoa(n.peers[2].raft), f"#{i}"
+
+
+def test_vote_request():
+    """§5.4.1: vote requests carry the candidate's log info."""
+    tests = [
+        ([empty_entry(1, 1)], 2),
+        ([empty_entry(1, 1), empty_entry(2, 2)], 3),
+    ]
+    for j, (ents, wterm) in enumerate(tests):
+        r = new_test_raft(1, [1, 2, 3], 10, 1)
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = wterm - 1
+        m.log_term = 0
+        m.index = 0
+        m.entries = [Entry(term=e.term, index=e.index) for e in ents]
+        r.step(m)
+        r.read_messages()
+
+        for _ in range(1, r.raft.election_timeout * 2):
+            r.raft.tick_election()
+
+        msgs = sorted(r.read_messages(), key=lambda m: m.to)
+        assert len(msgs) == 2, f"#{j}"
+        for i, m in enumerate(msgs):
+            assert m.msg_type == MessageType.MsgRequestVote, f"#{j}.{i}"
+            assert m.to == i + 2, f"#{j}.{i}"
+            assert m.term == wterm, f"#{j}.{i}"
+            assert m.index == ents[-1].index, f"#{j}.{i}"
+            assert m.log_term == ents[-1].term, f"#{j}.{i}"
+
+
+def test_voter():
+    """§5.4.1: votes are denied to candidates with less up-to-date logs."""
+    tests = [
+        ([empty_entry(1, 1)], 1, 1, False),
+        ([empty_entry(1, 1)], 1, 2, False),
+        ([empty_entry(1, 1), empty_entry(1, 2)], 1, 1, True),
+        ([empty_entry(1, 1)], 2, 1, False),
+        ([empty_entry(1, 1)], 2, 2, False),
+        ([empty_entry(1, 1), empty_entry(1, 2)], 2, 1, False),
+        ([empty_entry(2, 1)], 1, 1, True),
+        ([empty_entry(2, 1)], 1, 2, True),
+        ([empty_entry(2, 1), empty_entry(1, 2)], 1, 1, True),
+    ]
+    for i, (ents, log_term, index, wreject) in enumerate(tests):
+        s = MemStorage.new_with_conf_state(([1, 2], []))
+        with s.wl() as core:
+            core.append(ents)
+        r = new_test_raft_with_config(new_test_config(1, 10, 1), s)
+
+        m = new_message(2, 1, MessageType.MsgRequestVote)
+        m.term = 3
+        m.log_term = log_term
+        m.index = index
+        r.step(m)
+
+        msgs = r.read_messages()
+        assert len(msgs) == 1, f"#{i}"
+        assert msgs[0].msg_type == MessageType.MsgRequestVoteResponse, f"#{i}"
+        assert msgs[0].reject == wreject, f"#{i}"
+
+
+def test_leader_only_commits_log_from_current_term():
+    """§5.4.2: only current-term entries commit by counting replicas."""
+    ents = [empty_entry(1, 1), empty_entry(2, 2)]
+    tests = [(1, 0), (2, 0), (3, 3)]
+    for i, (index, wcommit) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1, 2], []))
+        with store.wl() as core:
+            core.append(ents)
+        r = new_test_raft_with_config(new_test_config(1, 10, 1), store)
+        r.raft.load_state(new_hard_state(2, 0, 0))
+
+        # become leader at term 3
+        r.raft.become_candidate()
+        r.raft.become_leader()
+        r.read_messages()
+
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        r.persist()
+
+        m = new_message(2, 1, MessageType.MsgAppendResponse)
+        m.term = r.term
+        m.index = index
+        r.step(m)
+        assert r.raft_log.committed == wcommit, f"#{i}"

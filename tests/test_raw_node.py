@@ -1,0 +1,516 @@
+"""RawNode / Ready protocol tests (ported behaviors from reference:
+harness/tests/integration_cases/test_raw_node.rs)."""
+
+import pytest
+
+from raft_tpu import (
+    Config,
+    ConfChange,
+    ConfChangeType,
+    ConfChangeV2,
+    ConfChangeSingle,
+    ConfChangeTransition,
+    ConfState,
+    Entry,
+    EntryType,
+    HardState,
+    MemStorage,
+    Message,
+    MessageType,
+    RawNode,
+    Ready,
+    SnapshotStatus,
+    StateRole,
+    StepLocalMsg,
+    StepPeerNotFound,
+    conf_state_eq,
+)
+from raft_tpu.eraftpb import decode_conf_change, decode_conf_change_v2
+from raft_tpu.raft_log import NO_LIMIT
+
+from test_util import (
+    new_message,
+    new_snapshot,
+    new_test_config,
+    new_test_raw_node,
+)
+
+
+def must_cmp_ready(
+    rd: Ready,
+    ss=None,
+    hs=None,
+    entries=(),
+    committed_entries=(),
+    must_sync=False,
+):
+    """reference: test_raw_node.rs:36-62"""
+    assert (rd.ss == ss) if ss is not None else rd.ss is None
+    assert (rd.hs == hs) if hs is not None else rd.hs is None
+    assert list(rd.entries) == list(entries)
+    assert list(rd.committed_entries()) == list(committed_entries)
+    assert rd.must_sync == must_sync
+    assert rd.snapshot.is_empty()
+    assert rd.read_states == []
+
+
+def new_raw_node(id, peers, election, heartbeat, storage=None):
+    return new_test_raw_node(id, peers, election, heartbeat, storage)
+
+
+def persist_ready(store: MemStorage, rd: Ready):
+    """Apply a Ready's persistence effects to MemStorage."""
+    if not rd.snapshot.is_empty():
+        with store.wl() as core:
+            core.apply_snapshot(rd.snapshot.clone())
+    if rd.entries:
+        with store.wl() as core:
+            core.append(rd.entries)
+    if rd.hs is not None:
+        with store.wl() as core:
+            core.set_hardstate(rd.hs.clone())
+
+
+def run_ready_loop(node: RawNode, store: MemStorage):
+    """Drain all pending readies, persisting and advancing."""
+    all_committed = []
+    while node.has_ready():
+        rd = node.ready()
+        persist_ready(store, rd)
+        all_committed.extend(rd.take_committed_entries())
+        light = node.advance(rd)
+        all_committed.extend(light.take_committed_entries())
+        node.advance_apply()
+    return all_committed
+
+
+def test_raw_node_step():
+    """Local messages are rejected; unknown-peer responses are dropped
+    (reference: test_raw_node.rs:92-112)."""
+    node = new_raw_node(1, [1], 10, 1)
+    for msg_type in (
+        MessageType.MsgHup,
+        MessageType.MsgBeat,
+        MessageType.MsgUnreachable,
+        MessageType.MsgSnapStatus,
+        MessageType.MsgCheckQuorum,
+    ):
+        with pytest.raises(StepLocalMsg):
+            node.step(Message(msg_type=msg_type))
+    # Response from an unknown peer is dropped.
+    with pytest.raises(StepPeerNotFound):
+        node.step(
+            Message(msg_type=MessageType.MsgAppendResponse, from_=99, term=0)
+        )
+
+
+def test_raw_node_propose_and_conf_change():
+    """Propose data + a v1 conf change through the Ready loop
+    (reference: test_raw_node.rs:181-227 simplified to the v1 case)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    run_ready_loop(node, store)
+
+    node.propose(b"", b"somedata")
+    cc = ConfChange(change_type=ConfChangeType.AddNode, node_id=2)
+    node.propose_conf_change(b"", cc)
+
+    committed = run_ready_loop(node, store)
+    data_ents = [e for e in committed if e.data]
+    assert len(data_ents) == 2
+    assert data_ents[0].data == b"somedata"
+    assert data_ents[1].entry_type == EntryType.EntryConfChange
+    cc_got = decode_conf_change(data_ents[1].data)
+    assert cc_got.node_id == 2
+
+    cs = node.apply_conf_change(cc_got)
+    assert sorted(cs.voters) == [1, 2]
+
+
+def test_raw_node_propose_add_duplicate_node():
+    """Duplicate AddNode applications are idempotent
+    (reference: test_raw_node.rs:467-523)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    run_ready_loop(node, store)
+
+    def propose_and_apply(cc):
+        node.propose_conf_change(b"", cc)
+        committed = run_ready_loop(node, store)
+        ents = [e for e in committed if e.entry_type == EntryType.EntryConfChange]
+        assert ents
+        return node.apply_conf_change(decode_conf_change(ents[-1].data))
+
+    # Add node 1 (already present) twice — idempotent; then node 2.
+    cc1 = ConfChange(change_type=ConfChangeType.AddNode, node_id=1)
+    cs = propose_and_apply(cc1)
+    assert sorted(cs.voters) == [1]
+    cs = propose_and_apply(cc1)
+    assert sorted(cs.voters) == [1]
+    cc2 = ConfChange(change_type=ConfChangeType.AddNode, node_id=2)
+    cs = propose_and_apply(cc2)
+    assert sorted(cs.voters) == [1, 2]
+
+
+def test_raw_node_propose_add_learner_node():
+    """reference: test_raw_node.rs:525-571"""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    run_ready_loop(node, store)
+
+    cc = ConfChange(change_type=ConfChangeType.AddLearnerNode, node_id=2)
+    node.propose_conf_change(b"", cc)
+    committed = run_ready_loop(node, store)
+    ents = [e for e in committed if e.entry_type == EntryType.EntryConfChange]
+    assert len(ents) == 1
+    cs = node.apply_conf_change(decode_conf_change(ents[0].data))
+    assert cs.voters == [1]
+    assert cs.learners == [2]
+
+
+def test_raw_node_joint_auto_leave():
+    """Implicit joint config auto-leaves once applied
+    (reference: test_raw_node.rs:368-465)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    run_ready_loop(node, store)
+
+    test_cc = ConfChangeV2(
+        transition=ConfChangeTransition.Implicit,
+        changes=[ConfChangeSingle(ConfChangeType.AddLearnerNode, 2)],
+    )
+    node.propose_conf_change(b"", test_cc)
+
+    # Drain readies, applying committed conf changes as the app must —
+    # until the leave is applied, commit_apply keeps the auto-leave pending.
+    conf_states = []
+    for _ in range(20):
+        if not node.has_ready():
+            break
+        rd = node.ready()
+        persist_ready(store, rd)
+        committed = rd.take_committed_entries()
+        light = node.advance(rd)
+        committed.extend(light.take_committed_entries())
+        for e in committed:
+            if e.entry_type == EntryType.EntryConfChangeV2:
+                conf_states.append(
+                    node.apply_conf_change(decode_conf_change_v2(e.data))
+                )
+        node.advance_apply()
+
+    # First applied change: the joint config (learner staged directly).
+    joint_cs = conf_states[0]
+    assert joint_cs.voters == [1]
+    assert joint_cs.voters_outgoing == [1]
+    assert joint_cs.learners == [2]
+    assert joint_cs.auto_leave
+    # The auto-leave empty change exits the joint config.
+    final_cs = conf_states[1]
+    assert final_cs.voters == [1]
+    assert final_cs.voters_outgoing == []
+    assert final_cs.learners == [2]
+    assert not final_cs.auto_leave
+    assert len(conf_states) == 2
+    assert not node.has_ready()
+
+
+def test_raw_node_start():
+    """The initial election + noop commit flow
+    (reference: test_raw_node.rs:614-665)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    assert not node.has_ready()
+    node.campaign()
+    rd = node.ready()
+    assert rd.must_sync
+    assert rd.hs == HardState(term=1, vote=1, commit=0)
+    assert len(rd.entries) == 1  # the noop
+    persist_ready(store, rd)
+    light = node.advance(rd)
+    assert light.commit_index == 1
+    assert len(light.committed_entries) == 1
+    node.advance_apply()
+
+    node.propose(b"", b"foo")
+    rd = node.ready()
+    assert len(rd.entries) == 1
+    assert rd.entries[0].data == b"foo"
+    assert rd.must_sync
+    persist_ready(store, rd)
+    light = node.advance(rd)
+    assert light.commit_index == 2
+    assert light.committed_entries[-1].data == b"foo"
+    node.advance_apply()
+    assert not node.has_ready()
+
+
+def test_raw_node_restart():
+    """reference: test_raw_node.rs:667-693"""
+    entries = [Entry(term=1, index=1), Entry(term=1, index=2, data=b"foo")]
+    store = MemStorage.new_with_conf_state(([1, 2], []))
+    with store.wl() as core:
+        core.append(entries)
+        core.set_hardstate(HardState(term=1, vote=0, commit=1))
+    cfg = new_test_config(1, 10, 1)
+    cfg.applied = 0
+    node = RawNode(cfg, store)
+
+    rd = node.ready()
+    assert rd.hs is None  # no change vs stored hard state
+    assert not rd.entries
+    # committed entries up to the stored commit index are re-delivered
+    assert [e.index for e in rd.committed_entries()] == [1]
+    assert not rd.must_sync
+    node.advance(rd)
+    node.advance_apply()
+    assert not node.has_ready()
+
+
+def test_raw_node_restart_from_snapshot():
+    """reference: test_raw_node.rs:695-715"""
+    snap = new_snapshot(2, 1, [1, 2])
+    entries = [Entry(term=1, index=3, data=b"foo")]
+    store = MemStorage()
+    with store.wl() as core:
+        core.apply_snapshot(snap)
+        core.append(entries)
+        core.set_hardstate(HardState(term=1, vote=0, commit=3))
+    cfg = new_test_config(1, 10, 1)
+    node = RawNode(cfg, store)
+
+    rd = node.ready()
+    assert rd.hs is None
+    assert not rd.entries
+    assert [e.index for e in rd.committed_entries()] == [3]
+    assert not rd.must_sync
+    node.advance(rd)
+    node.advance_apply()
+    assert not node.has_ready()
+
+
+def test_skip_bcast_commit():
+    """reference: test_raw_node.rs:717-786"""
+    from raft_tpu.harness import Network
+    from test_util import new_message_with_entries, new_test_raft_with_config
+
+    def make(id, skip):
+        cfg = Network.default_config()
+        cfg.id = id
+        cfg.skip_bcast_commit = skip
+        s = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        from raft_tpu import Raft
+        from raft_tpu.harness import Interface
+        return Interface(Raft(cfg, s))
+
+    # Only the leader-to-be uses skip_bcast_commit (as in the reference).
+    net = Network.new([make(1, True), make(2, False), make(3, False)])
+    net.send([Message(msg_type=MessageType.MsgHup, from_=1, to=1)])
+
+    # Without bcast commit, followers don't learn new commit indexes
+    # immediately (the election noop still propagated commit 1).
+    test_entries = Entry(data=b"testdata")
+    msg = new_message_with_entries(1, 1, MessageType.MsgPropose, [test_entries])
+    net.send([Message(msg_type=msg.msg_type, from_=1, to=1, entries=[Entry(data=b"testdata")])])
+    assert net.peers[1].raft_log.committed == 2
+    assert net.peers[2].raft_log.committed == 1
+    assert net.peers[3].raft_log.committed == 1
+
+    # After bcast heartbeat, followers learn the actual commit index.
+    for _ in range(net.peers[1].raft.randomized_election_timeout):
+        net.peers[1].raft.tick()
+    net.send([Message(msg_type=MessageType.MsgHup, from_=1, to=1)])
+    assert net.peers[2].raft_log.committed == 2
+    assert net.peers[3].raft_log.committed == 2
+
+    # The feature is adjustable at run time.
+    net.peers[1].raft.set_skip_bcast_commit(False)
+    net.send([Message(msg_type=msg.msg_type, from_=1, to=1, entries=[Entry(data=b"testdata")])])
+    for p in (1, 2, 3):
+        assert net.peers[p].raft_log.committed == 3
+
+    net.peers[1].raft.set_skip_bcast_commit(True)
+
+    # A later proposal commits the former one on followers.
+    net.send([Message(msg_type=msg.msg_type, from_=1, to=1, entries=[Entry(data=b"testdata")])])
+    net.send([Message(msg_type=msg.msg_type, from_=1, to=1, entries=[Entry(data=b"testdata")])])
+    assert net.peers[1].raft_log.committed == 5
+    assert net.peers[2].raft_log.committed == 4
+    assert net.peers[3].raft_log.committed == 4
+
+    # Pending conf changes force commit broadcast.
+    from raft_tpu.eraftpb import encode_conf_change
+    cc = ConfChange(change_type=ConfChangeType.RemoveNode, node_id=3)
+    cc_entry = Entry(
+        entry_type=EntryType.EntryConfChange, data=encode_conf_change(cc)
+    )
+    net.send([
+        Message(msg_type=MessageType.MsgPropose, from_=1, to=1, entries=[cc_entry])
+    ])
+    for p in (1, 2, 3):
+        assert net.peers[p].raft.should_bcast_commit()
+        assert net.peers[p].raft_log.committed == 6
+
+
+def test_set_priority():
+    """reference: test_raw_node.rs:788-801"""
+    node = new_raw_node(1, [1], 10, 1)
+    for p in (0, 1, 5):
+        node.set_priority(p)
+        assert node.raft.priority == p
+
+
+def test_bounded_uncommitted_entries_growth_with_partition():
+    """max_uncommitted_size bounds proposal growth when commits stall
+    (reference: test_raw_node.rs:803-849)."""
+    from raft_tpu import ProposalDropped
+
+    store = MemStorage.new_with_conf_state(([1], []))
+    cfg = Config(id=1, election_tick=10, heartbeat_tick=1, max_uncommitted_size=12)
+    node = RawNode(cfg, store)
+    node.campaign()
+    rd = node.ready()
+    persist_ready(store, rd)
+    node.advance(rd)
+    node.advance_apply()
+
+    # Become leader; propose a first entry (always admitted).
+    node.propose(b"", b"a" * 10)
+    # Further proposals overflow the uncommitted budget.
+    with pytest.raises(ProposalDropped):
+        node.propose(b"", b"b" * 10)
+
+    # Drain the ready (applies/commits the first entry), freeing budget.
+    rd = node.ready()
+    persist_ready(store, rd)
+    node.advance(rd)
+    node.advance_apply()
+    node.propose(b"", b"c" * 10)
+
+
+def test_raw_node_with_async_apply():
+    """Committed entries can be applied in arbitrary chunks later
+    (reference: test_raw_node.rs:851-898)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    rd = node.ready()
+    persist_ready(store, rd)
+    node.advance(rd)
+    node.advance_apply()
+
+    last_index = node.raft.raft_log.last_index()
+    data = b"hello world!"
+    for _ in range(10):
+        node.propose(b"", data)
+
+    rd = node.ready()
+    entries = rd.take_entries()
+    assert len(entries) == 10
+    persist_ready_entries(store, entries, rd)
+    light = node.advance(rd)
+    committed = light.take_committed_entries()
+    assert len(committed) == 10
+    assert committed[0].index == last_index + 1
+    assert committed[-1].index == last_index + 10
+    node.advance_apply_to(last_index + 10)
+
+
+def persist_ready_entries(store, entries, rd):
+    if entries:
+        with store.wl() as core:
+            core.append(entries)
+    if rd.hs is not None:
+        with store.wl() as core:
+            core.set_hardstate(rd.hs.clone())
+
+
+def test_async_ready_become_leader():
+    """Numbered readies + on_persist_ready ordering across an election
+    (reference: test_raw_node.rs:1403-1501, condensed)."""
+    store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+    node = new_raw_node(1, [1, 2, 3], 10, 1, store)
+    node.raft.become_follower(1, 2)
+
+    # Local campaign.
+    node.campaign()
+    rd = node.ready()
+    assert rd.must_sync  # vote/term changed
+    number = rd.number
+    persist_ready(store, rd)
+    node.advance_append_async(rd)
+    node.on_persist_ready(number)
+
+    # Receive votes, become leader.
+    for from_ in (2, 3):
+        m = Message(
+            msg_type=MessageType.MsgRequestVoteResponse,
+            from_=from_,
+            to=1,
+            term=node.raft.term,
+        )
+        node.step(m)
+    assert node.raft.state == StateRole.Leader
+
+    rd = node.ready()
+    assert rd.must_sync  # the noop entry
+    assert len(rd.entries) == 1
+    # Leader messages are immediate (pipelining).
+    assert rd.persisted_messages() == []
+    persist_ready(store, rd)
+    node.advance_append_async(rd)
+    node.on_persist_ready(rd.number)
+
+
+def test_committed_entries_pagination():
+    """max_committed_size_per_ready paginates committed entries
+    (reference: test_raw_node.rs:1586-1643)."""
+    store = MemStorage.new_with_conf_state(([1], []))
+    cfg = new_test_config(1, 10, 1)
+    # Entry overhead is 12 bytes; 3 entries of 100 bytes ≈ 336.
+    cfg.max_committed_size_per_ready = 112 * 2
+    node = RawNode(cfg, store)
+    node.campaign()
+    rd = node.ready()
+    persist_ready(store, rd)
+    node.advance(rd)
+    node.advance_apply()
+
+    for _ in range(3):
+        node.propose(b"", b"x" * 100)
+
+    rd = node.ready()
+    persist_ready(store, rd)
+    light = node.advance(rd)
+    got = light.take_committed_entries()
+    node.advance_apply()
+    # Remaining entries come in the next ready.
+    while node.has_ready():
+        rd = node.ready()
+        persist_ready(store, rd)
+        got.extend(rd.take_committed_entries())
+        light = node.advance(rd)
+        got.extend(light.take_committed_entries())
+        node.advance_apply()
+    assert len([e for e in got if e.data]) == 3
+
+
+def test_raw_node_read_index():
+    """reference: test_raw_node.rs:573-612"""
+    store = MemStorage.new_with_conf_state(([1], []))
+    node = new_raw_node(1, [1], 10, 1, store)
+    node.campaign()
+    run_ready_loop(node, store)
+
+    node.read_index(b"ctx")
+    assert node.has_ready()
+    rd = node.ready()
+    assert len(rd.read_states) == 1
+    assert rd.read_states[0].request_ctx == b"ctx"
+    persist_ready(store, rd)
+    node.advance(rd)
+    node.advance_apply()
