@@ -1,0 +1,218 @@
+"""Membership-change tests (ported behaviors from reference:
+src/confchange/{changer,restore}.rs + datadriven testdata semantics:
+simple safety, joint idempotency/safety, learners_next staging, autoleave,
+restore round-trips)."""
+
+import random
+
+import pytest
+
+from raft_tpu import ConfChangeError, ConfState, conf_state_eq
+from raft_tpu.confchange import Changer, MapChangeType, joint, restore
+from raft_tpu.eraftpb import ConfChangeSingle, ConfChangeType
+from raft_tpu.tracker import ProgressTracker
+
+V = ConfChangeType.AddNode
+L = ConfChangeType.AddLearnerNode
+R = ConfChangeType.RemoveNode
+
+
+def cc(t, id):
+    return ConfChangeSingle(t, id)
+
+
+def apply_simple(tracker, ccs):
+    cfg, changes = Changer(tracker).simple(ccs)
+    tracker.apply_conf(cfg, changes, 10)
+
+
+def new_tracker(*ccs_lists):
+    t = ProgressTracker(256)
+    for ccs in ccs_lists:
+        apply_simple(t, ccs)
+    return t
+
+
+def test_simple_add_voters():
+    t = new_tracker([cc(V, 1)], [cc(V, 2)], [cc(V, 3)])
+    assert t.conf.voters.incoming.ids() == {1, 2, 3}
+    assert set(t.progress.keys()) == {1, 2, 3}
+
+
+def test_simple_add_learner():
+    t = new_tracker([cc(V, 1)], [cc(L, 2)])
+    assert t.conf.voters.incoming.ids() == {1}
+    assert t.conf.learners == {2}
+
+
+def test_simple_remove():
+    t = new_tracker([cc(V, 1)], [cc(V, 2)])
+    apply_simple(t, [cc(R, 2)])
+    assert t.conf.voters.incoming.ids() == {1}
+    assert 2 not in t.progress
+
+
+def test_simple_cannot_change_two_voters():
+    t = new_tracker([cc(V, 1)])
+    with pytest.raises(ConfChangeError):
+        Changer(t).simple([cc(V, 2), cc(V, 3)])
+
+
+def test_simple_can_change_voter_plus_learner():
+    # One voter change + learner changes is fine (symmetric diff of the
+    # incoming voter set is what's bounded).
+    t = new_tracker([cc(V, 1)])
+    apply_simple(t, [cc(V, 2), cc(L, 3)])
+    assert t.conf.voters.incoming.ids() == {1, 2}
+    assert t.conf.learners == {3}
+
+
+def test_simple_promote_demote():
+    t = new_tracker([cc(V, 1)], [cc(L, 2)])
+    # promote learner
+    apply_simple(t, [cc(V, 2)])
+    assert t.conf.voters.incoming.ids() == {1, 2}
+    assert t.conf.learners == set()
+    # demote voter
+    apply_simple(t, [cc(L, 2)])
+    assert t.conf.voters.incoming.ids() == {1}
+    assert t.conf.learners == {2}
+
+
+def test_simple_idempotency():
+    t = new_tracker([cc(V, 1)])
+    apply_simple(t, [cc(V, 1)])
+    assert t.conf.voters.incoming.ids() == {1}
+    apply_simple(t, [cc(L, 2)])
+    apply_simple(t, [cc(L, 2)])
+    assert t.conf.learners == {2}
+    apply_simple(t, [cc(R, 9)])  # removing a non-member is a no-op
+    assert t.conf.voters.incoming.ids() == {1}
+
+
+def test_cannot_remove_all_voters():
+    t = new_tracker([cc(V, 1)])
+    with pytest.raises(ConfChangeError):
+        Changer(t).simple([cc(R, 1)])
+
+
+def test_zero_node_id_ignored():
+    t = new_tracker([cc(V, 1)])
+    apply_simple(t, [cc(V, 0)])
+    assert t.conf.voters.incoming.ids() == {1}
+
+
+def test_enter_joint():
+    t = new_tracker([cc(V, 1)], [cc(V, 2)], [cc(V, 3)])
+    cfg, changes = Changer(t).enter_joint(True, [cc(V, 4), cc(R, 1)])
+    t.apply_conf(cfg, changes, 10)
+    assert joint(t.conf)
+    assert t.conf.voters.incoming.ids() == {2, 3, 4}
+    assert t.conf.voters.outgoing.ids() == {1, 2, 3}
+    assert t.conf.auto_leave
+
+
+def test_enter_joint_twice_fails():
+    t = new_tracker([cc(V, 1)])
+    cfg, changes = Changer(t).enter_joint(False, [cc(V, 2)])
+    t.apply_conf(cfg, changes, 10)
+    with pytest.raises(ConfChangeError):
+        Changer(t).enter_joint(False, [cc(V, 3)])
+
+
+def test_leave_joint_non_joint_fails():
+    t = new_tracker([cc(V, 1)])
+    with pytest.raises(ConfChangeError):
+        Changer(t).leave_joint()
+
+
+def test_joint_demotion_stages_learner():
+    """Demoting a voter during a joint transition stages it in
+    learners_next, preserving voter/learner disjointness
+    (reference: tracker.rs:50-83 + changer.rs:210-234)."""
+    t = new_tracker([cc(V, 1)], [cc(V, 2)], [cc(V, 3)])
+    cfg, changes = Changer(t).enter_joint(False, [cc(L, 3)])
+    t.apply_conf(cfg, changes, 10)
+    assert t.conf.voters.incoming.ids() == {1, 2}
+    assert t.conf.voters.outgoing.ids() == {1, 2, 3}
+    assert t.conf.learners == set()
+    assert t.conf.learners_next == {3}
+    # 3 keeps its Progress while in the joint config.
+    assert 3 in t.progress
+
+    cfg, changes = Changer(t).leave_joint()
+    t.apply_conf(cfg, changes, 10)
+    assert t.conf.voters.incoming.ids() == {1, 2}
+    assert t.conf.voters.outgoing.is_empty()
+    assert t.conf.learners == {3}
+    assert t.conf.learners_next == set()
+    assert 3 in t.progress
+
+
+def test_leave_joint_removes_outgoing_only_members():
+    t = new_tracker([cc(V, 1)], [cc(V, 2)], [cc(V, 3)])
+    cfg, changes = Changer(t).enter_joint(False, [cc(R, 3)])
+    t.apply_conf(cfg, changes, 10)
+    assert 3 in t.progress  # still an outgoing voter
+    cfg, changes = Changer(t).leave_joint()
+    t.apply_conf(cfg, changes, 10)
+    assert 3 not in t.progress
+    assert t.conf.voters.incoming.ids() == {1, 2}
+
+
+def test_restore_simple():
+    cs = ConfState(voters=[1, 2, 3], learners=[4])
+    t = ProgressTracker(256)
+    restore(t, 10, cs)
+    assert conf_state_eq(t.conf.to_conf_state(), cs)
+    assert set(t.progress.keys()) == {1, 2, 3, 4}
+
+
+def test_restore_joint():
+    cs = ConfState(
+        voters=[1, 2, 3],
+        learners=[5],
+        voters_outgoing=[1, 2, 4, 6],
+        learners_next=[4],
+        auto_leave=True,
+    )
+    t = ProgressTracker(256)
+    restore(t, 10, cs)
+    got = t.conf.to_conf_state()
+    assert conf_state_eq(got, cs)
+    assert set(t.progress.keys()) == {1, 2, 3, 4, 5, 6}
+
+
+def test_restore_random_round_trips():
+    """Any reachable ConfState must restore to itself (the reference's
+    fuzzed restore test, confchange/restore.rs tests)."""
+    rng = random.Random(42)
+    for _ in range(200):
+        ids = list(range(1, 9))
+        rng.shuffle(ids)
+        n_inc = rng.randint(1, 4)
+        incoming = ids[:n_inc]
+        rest = ids[n_inc:]
+        n_out = rng.randint(0, 3)
+        # outgoing may overlap incoming
+        outgoing = rng.sample(incoming, min(len(incoming), rng.randint(0, 2)))
+        outgoing += rest[:n_out]
+        rest = rest[n_out:]
+        n_learners = rng.randint(0, 2)
+        learners = rest[:n_learners]
+        # learners_next must be outgoing-only members
+        out_only = [x for x in outgoing if x not in incoming]
+        learners_next = rng.sample(out_only, min(len(out_only), rng.randint(0, 2)))
+        if not outgoing:
+            learners_next = []
+        cs = ConfState(
+            voters=incoming,
+            learners=learners,
+            voters_outgoing=outgoing,
+            learners_next=learners_next,
+            auto_leave=bool(outgoing) and rng.random() < 0.5,
+        )
+        t = ProgressTracker(256)
+        restore(t, 10, cs)
+        got = t.conf.to_conf_state()
+        assert conf_state_eq(got, cs), f"{cs} != {got}"
