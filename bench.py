@@ -2,14 +2,16 @@
 shape: 100k groups × 5 peers, steady append load).
 
 Runs the fused MultiRaft round on the default JAX device (the real TPU under
-the driver) with a lax.scan-batched dispatch, anchors against the scalar
-CPU RawNode loop (the same protocol through raft_tpu.harness at small G,
-scaled per-group), and prints ONE JSON line:
+the driver) with a lax.scan-batched dispatch, anchors against the native C++
+scalar engine running the identical protocol (cpp/multiraft_engine.cpp,
+parity-tested bit-exact against both the device sim and the scalar Python
+Raft core), and prints ONE JSON line:
 
   {"metric": ..., "value": ..., "unit": "ticks/sec", "vs_baseline": ...}
 
-vs_baseline = device ticks/sec ÷ scalar-core ticks/sec (the reference
-publishes no numbers — BASELINE.md — so the anchor is measured in-process).
+vs_baseline = device ticks/sec ÷ native-CPU ticks/sec, both at the same
+per-group work (the reference publishes no numbers — BASELINE.md — so the
+anchor is measured in-process on the same host).
 """
 
 import functools
@@ -26,8 +28,8 @@ G = 100_000
 P = 5
 ROUNDS_PER_SCAN = 50
 SCANS = 4
-ANCHOR_GROUPS = 32
-ANCHOR_ROUNDS = 30
+ANCHOR_GROUPS = 4096
+ANCHOR_ROUNDS = 60
 
 
 def bench_device() -> float:
@@ -36,7 +38,7 @@ def bench_device() -> float:
 
     cfg = SimConfig(n_groups=G, n_peers=P)
     state = sim.init_state(cfg)
-    crashed = jnp.zeros((G, P), bool)
+    crashed = jnp.zeros((P, G), bool)
     append = jnp.ones((G,), jnp.int32)
 
     step = functools.partial(sim.step, cfg)
@@ -61,22 +63,20 @@ def bench_device() -> float:
 
     ticks = G * ROUNDS_PER_SCAN * SCANS
     # Sanity: the protocol is actually running (leaders + commits advance).
-    commit_min = int(jnp.min(jnp.max(state.commit, axis=-1)))
+    commit_min = int(jnp.min(jnp.max(state.commit, axis=0)))
     assert commit_min > 0, "bench sanity: no commits on device"
     return ticks / dt
 
 
 def bench_scalar_anchor() -> float:
-    from raft_tpu.multiraft.simref import ScalarCluster
+    from raft_tpu.multiraft.native import NativeMultiRaft
 
-    cluster = ScalarCluster(ANCHOR_GROUPS, P)
-    append = np.ones((ANCHOR_GROUPS,), dtype=np.int64)
+    engine = NativeMultiRaft(ANCHOR_GROUPS, P)
+    append = np.ones((ANCHOR_GROUPS,), dtype=np.int32)
     # Let elections settle before timing (same steady state as the device).
-    for _ in range(25):
-        cluster.round(None, append)
+    engine.run(25, None, append)
     t0 = time.perf_counter()
-    for _ in range(ANCHOR_ROUNDS):
-        cluster.round(None, append)
+    engine.run(ANCHOR_ROUNDS, None, append)
     dt = time.perf_counter() - t0
     return ANCHOR_GROUPS * ANCHOR_ROUNDS / dt
 

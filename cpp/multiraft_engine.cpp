@@ -1,0 +1,300 @@
+// Native multi-group Raft engine: the scalar CPU execution path of the
+// batched MultiRaft protocol (same round semantics as
+// raft_tpu/multiraft/sim.py, which is parity-tested against the scalar
+// Python Raft state machines in raft_tpu/raft.py; reference semantics:
+// raft.rs tick/campaign/step + quorum/majority.rs committed_index).
+//
+// This is the framework's native runtime core and the honest CPU anchor for
+// bench.py: a tight array-of-struct loop with no interpreter overhead,
+// advancing G groups x P peers one protocol round per step.  Exposed via a
+// C ABI for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -o libmultiraft.so multiraft_engine.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr int32_t ROLE_FOLLOWER = 0;
+constexpr int32_t ROLE_CANDIDATE = 1;
+constexpr int32_t ROLE_LEADER = 2;
+
+// 32-bit murmur3-finalizer mix; MUST match raft_tpu.util.mix32 so all three
+// backends (C++, Python scalar, XLA) draw identical election timeouts.
+inline uint32_t mix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+
+inline int32_t timeout_draw(uint32_t node_key, uint32_t term, int32_t lo,
+                            int32_t hi) {
+  uint32_t x = node_key * 0x9E3779B1u + term;
+  return lo + static_cast<int32_t>(mix32(x) % static_cast<uint32_t>(hi - lo));
+}
+
+struct Peer {
+  int32_t term = 0;
+  int32_t state = ROLE_FOLLOWER;
+  int32_t vote = 0;       // 0 = none, else peer id 1..P
+  int32_t leader_id = 0;  // 0 = none
+  int32_t election_elapsed = 0;
+  int32_t heartbeat_elapsed = 0;
+  int32_t randomized_timeout = 0;
+  int32_t last_index = 0;
+  int32_t last_term = 0;
+  int32_t commit = 0;
+};
+
+struct Group {
+  std::vector<Peer> peers;
+  std::vector<int32_t> matched;  // acting leader's tracker
+  int32_t term_start_index = 0;
+};
+
+struct Engine {
+  int32_t G, P, election_tick, heartbeat_tick;
+  std::vector<Group> groups;
+
+  uint32_t node_key(int g, int p) const {
+    return static_cast<uint32_t>(g) * 65536u + static_cast<uint32_t>(p + 1);
+  }
+
+  Engine(int32_t g, int32_t p, int32_t et, int32_t ht)
+      : G(g), P(p), election_tick(et), heartbeat_tick(ht) {
+    groups.resize(G);
+    for (int gi = 0; gi < G; ++gi) {
+      auto& grp = groups[gi];
+      grp.peers.resize(P);
+      grp.matched.assign(P, 0);
+      for (int pi = 0; pi < P; ++pi) {
+        grp.peers[pi].randomized_timeout =
+            timeout_draw(node_key(gi, pi), 0, election_tick, 2 * election_tick);
+      }
+    }
+  }
+
+  // One protocol round for one group; `crashed` has P entries, `append_n`
+  // is the workload proposed at the acting leader.  Phases mirror
+  // sim.py::step exactly (tick -> campaign -> election -> replication).
+  void step_group(int gi, const uint8_t* crashed, int32_t append_n) {
+    auto& grp = groups[gi];
+    auto& ps = grp.peers;
+    const int32_t lo = election_tick, hi = 2 * election_tick;
+
+    // Phase A+B: tick everyone; timeouts start campaigns
+    // (reference: raft.rs:1024-1079, 1101-1117).
+    int n_req = 0;
+    int32_t t_star = 0;
+    bool req[16] = {false};
+    bool want_beat[16] = {false};
+    for (int p = 0; p < P; ++p) {
+      Peer& pr = ps[p];
+      bool is_leader = pr.state == ROLE_LEADER;
+      pr.election_elapsed += 1;
+      if (is_leader) {
+        pr.heartbeat_elapsed += 1;
+        if (pr.election_elapsed >= election_tick) pr.election_elapsed = 0;
+        if (pr.heartbeat_elapsed >= heartbeat_tick) {
+          pr.heartbeat_elapsed = 0;
+          want_beat[p] = true;
+        }
+      } else if (pr.election_elapsed >= pr.randomized_timeout) {
+        // campaign: become candidate
+        pr.election_elapsed = 0;
+        pr.term += 1;
+        pr.state = ROLE_CANDIDATE;
+        pr.vote = p + 1;
+        pr.leader_id = 0;
+        pr.randomized_timeout =
+            timeout_draw(node_key(gi, p), pr.term, lo, hi);
+        if (!crashed[p]) {
+          req[p] = true;
+          ++n_req;
+          t_star = std::max(t_star, pr.term);
+        }
+      }
+    }
+
+    // Phase C: election resolution among alive requesters at t_star.
+    bool winner_elected = false;
+    if (n_req > 0) {
+      // term bump for alive peers below t_star (request receipt).
+      for (int p = 0; p < P; ++p) {
+        Peer& pr = ps[p];
+        if (!crashed[p] && pr.term < t_star) {
+          pr.term = t_star;
+          pr.state = ROLE_FOLLOWER;
+          pr.vote = 0;
+          pr.leader_id = 0;
+          pr.election_elapsed = 0;
+          pr.heartbeat_elapsed = 0;
+          pr.randomized_timeout = timeout_draw(node_key(gi, p), pr.term, lo, hi);
+        }
+      }
+      // votes: each responder grants the lowest-index eligible candidate.
+      int votes_for[16] = {0};
+      int n_responders = 0;
+      for (int v = 0; v < P; ++v) {
+        Peer& pv = ps[v];
+        if (crashed[v] || pv.term != t_star) continue;
+        ++n_responders;
+        if (pv.vote != 0) {
+          // requesters voted self
+          if (req[v] && ps[v].term == t_star) votes_for[v] += 1;
+          continue;
+        }
+        for (int c = 0; c < P; ++c) {
+          if (!req[c] || ps[c].term != t_star) continue;
+          bool up_to_date =
+              (ps[c].last_term > pv.last_term) ||
+              (ps[c].last_term == pv.last_term &&
+               ps[c].last_index >= pv.last_index);
+          if (up_to_date) {
+            pv.vote = c + 1;
+            votes_for[c] += 1;
+            break;
+          }
+        }
+      }
+      const int quorum = P / 2 + 1;
+      const int missing = P - n_responders;
+      int winner = -1;
+      for (int c = 0; c < P; ++c) {
+        if (!req[c] || ps[c].term != t_star) continue;
+        if (votes_for[c] >= quorum) winner = c;
+      }
+      for (int c = 0; c < P; ++c) {
+        if (!req[c] || ps[c].term != t_star || c == winner) continue;
+        bool lost = votes_for[c] + missing < quorum;
+        if (lost || (winner >= 0 && !crashed[c])) {
+          ps[c].state = ROLE_FOLLOWER;
+          ps[c].randomized_timeout =
+              timeout_draw(node_key(gi, c), ps[c].term, lo, hi);
+          ps[c].election_elapsed = 0;
+        }
+      }
+      if (winner >= 0) {
+        winner_elected = true;
+        Peer& w = ps[winner];
+        w.state = ROLE_LEADER;
+        w.leader_id = winner + 1;
+        w.randomized_timeout =
+            timeout_draw(node_key(gi, winner), w.term, lo, hi);
+        w.election_elapsed = 0;
+        w.heartbeat_elapsed = 0;
+        // noop entry (reference: raft.rs:1190-1194)
+        w.last_index += 1;
+        w.last_term = t_star;
+        grp.term_start_index = w.last_index;
+        std::fill(grp.matched.begin(), grp.matched.end(), 0);
+      }
+    }
+
+    // Phase D: replication round under the acting leader.
+    int lidx = -1;
+    int32_t lead_term = -1;
+    for (int p = 0; p < P; ++p) {
+      if (!crashed[p] && ps[p].state == ROLE_LEADER && ps[p].term > lead_term) {
+        lidx = p;
+        lead_term = ps[p].term;
+      }
+    }
+    if (lidx < 0) return;
+    Peer& lead = ps[lidx];
+
+    bool sent = want_beat[lidx] || append_n > 0 || winner_elected;
+    if (append_n > 0) {
+      lead.last_index += append_n;
+      lead.last_term = lead.term;
+    }
+    if (!sent) return;
+
+    // sync alive peers with term <= leader's; collect acks.
+    grp.matched[lidx] = lead.last_index;
+    for (int p = 0; p < P; ++p) {
+      if (p == lidx || crashed[p]) continue;
+      Peer& f = ps[p];
+      if (f.term > lead_term) continue;
+      bool bumped = f.term < lead_term;
+      f.term = lead_term;
+      f.state = ROLE_FOLLOWER;
+      if (bumped) {
+        f.vote = 0;
+        f.randomized_timeout = timeout_draw(node_key(gi, p), f.term, lo, hi);
+      }
+      f.leader_id = lidx + 1;
+      f.election_elapsed = 0;
+      f.last_index = lead.last_index;
+      f.last_term = lead.last_term;
+      grp.matched[p] = f.last_index;
+    }
+
+    // quorum commit, gated on current-term entries
+    // (reference: majority.rs:70-124 + raft_log.rs:487-499).
+    std::vector<int32_t> sorted(grp.matched);
+    std::sort(sorted.begin(), sorted.end(), std::greater<int32_t>());
+    int32_t mci = sorted[P / 2];  // quorum-th largest
+    if (mci >= grp.term_start_index && mci > lead.commit) lead.commit = mci;
+    for (int p = 0; p < P; ++p) {
+      if (p == lidx || crashed[p]) continue;
+      if (ps[p].term == lead_term && ps[p].state == ROLE_FOLLOWER &&
+          ps[p].leader_id == lidx + 1) {
+        ps[p].commit = lead.commit;
+      }
+    }
+  }
+
+  void step(const uint8_t* crashed, const int32_t* append_n) {
+    for (int g = 0; g < G; ++g) {
+      step_group(g, crashed + static_cast<size_t>(g) * P, append_n[g]);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mr_create(int32_t n_groups, int32_t n_peers, int32_t election_tick,
+                int32_t heartbeat_tick) {
+  if (n_peers > 16) return nullptr;
+  return new Engine(n_groups, n_peers, election_tick, heartbeat_tick);
+}
+
+void mr_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+void mr_step(void* h, const uint8_t* crashed, const int32_t* append_n) {
+  static_cast<Engine*>(h)->step(crashed, append_n);
+}
+
+void mr_run(void* h, const uint8_t* crashed, const int32_t* append_n,
+            int32_t rounds) {
+  auto* e = static_cast<Engine*>(h);
+  for (int32_t i = 0; i < rounds; ++i) e->step(crashed, append_n);
+}
+
+// Read out [G, P] planes for parity checks / status.
+void mr_read_state(void* h, int32_t* term, int32_t* state, int32_t* commit,
+                   int32_t* last_index, int32_t* last_term) {
+  auto* e = static_cast<Engine*>(h);
+  size_t i = 0;
+  for (auto& g : e->groups) {
+    for (auto& p : g.peers) {
+      term[i] = p.term;
+      state[i] = p.state;
+      commit[i] = p.commit;
+      last_index[i] = p.last_index;
+      last_term[i] = p.last_term;
+      ++i;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+"""ctypes bindings for the native C++ multi-group Raft engine
+(cpp/multiraft_engine.cpp) — the framework's native scalar runtime and the
+CPU anchor for bench.py.
+
+The shared library is built lazily with g++ on first use and cached next to
+the source (no pybind11 in the image; plain C ABI via ctypes)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+_SO_PATH = os.path.abspath(os.path.join(_CPP_DIR, "libmultiraft.so"))
+_SRC_PATH = os.path.abspath(os.path.join(_CPP_DIR, "multiraft_engine.cpp"))
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    subprocess.run(
+        [
+            "g++",
+            "-O3",
+            "-std=c++17",
+            "-shared",
+            "-fPIC",
+            "-o",
+            _SO_PATH,
+            _SRC_PATH,
+        ],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) or os.path.getmtime(
+            _SO_PATH
+        ) < os.path.getmtime(_SRC_PATH):
+            _build()
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.mr_create.restype = ctypes.c_void_p
+        lib.mr_create.argtypes = [ctypes.c_int32] * 4
+        lib.mr_destroy.argtypes = [ctypes.c_void_p]
+        lib.mr_step.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.mr_run.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.mr_read_state.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_int32)
+        ] * 5
+        _lib = lib
+        return lib
+
+
+class NativeMultiRaft:
+    """G groups × P peers advancing one protocol round per step() — the C++
+    twin of ClusterSim/ScalarCluster (same round semantics, same timeout
+    PRNG)."""
+
+    def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
+                 heartbeat_tick: int = 1):
+        assert n_peers <= 16
+        self.lib = load_library()
+        self.G, self.P = n_groups, n_peers
+        self.handle = self.lib.mr_create(
+            n_groups, n_peers, election_tick, heartbeat_tick
+        )
+        if not self.handle:
+            raise RuntimeError("mr_create failed")
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            self.lib.mr_destroy(self.handle)
+            self.handle = None
+
+    def _bufs(self, crashed, append_n):
+        if crashed is None:
+            crashed = np.zeros((self.G, self.P), dtype=np.uint8)
+        else:
+            crashed = np.ascontiguousarray(crashed, dtype=np.uint8)
+        if append_n is None:
+            append_n = np.zeros((self.G,), dtype=np.int32)
+        else:
+            append_n = np.ascontiguousarray(append_n, dtype=np.int32)
+        return (
+            crashed,
+            append_n,
+            crashed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            append_n.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+
+    def step(self, crashed=None, append_n=None) -> None:
+        c, a, cp, ap = self._bufs(crashed, append_n)
+        self.lib.mr_step(self.handle, cp, ap)
+
+    def run(self, rounds: int, crashed=None, append_n=None) -> None:
+        c, a, cp, ap = self._bufs(crashed, append_n)
+        self.lib.mr_run(self.handle, cp, ap, rounds)
+
+    def snapshot(self) -> dict:
+        shape = (self.G, self.P)
+        out = {
+            k: np.zeros(shape, dtype=np.int32)
+            for k in ("term", "state", "commit", "last_index", "last_term")
+        }
+        ptrs = [
+            out[k].ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            for k in ("term", "state", "commit", "last_index", "last_term")
+        ]
+        self.lib.mr_read_state(self.handle, *ptrs)
+        return out

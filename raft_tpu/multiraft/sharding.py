@@ -36,15 +36,16 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
 
 
 def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
-    """PartitionSpecs for every SimState field: G sharded, P replicated
-    within the shard (it's the minor axis of the same arrays)."""
-    gp = NamedSharding(mesh, P(axis, None))
+    """PartitionSpecs for every SimState field: the group axis (minor, the
+    vector-lane axis of the peer-major [P, G] layout) is sharded; the peer
+    axis stays local to the chip."""
+    pg = NamedSharding(mesh, P(None, axis))
     g = NamedSharding(mesh, P(axis))
     return SimState(
-        term=gp, state=gp, vote=gp, leader_id=gp,
-        election_elapsed=gp, heartbeat_elapsed=gp, randomized_timeout=gp,
-        last_index=gp, last_term=gp, commit=gp,
-        matched=gp, term_start_index=g, voter_mask=gp,
+        term=pg, state=pg, vote=pg, leader_id=pg,
+        election_elapsed=pg, heartbeat_elapsed=pg, randomized_timeout=pg,
+        last_index=pg, last_term=pg, commit=pg,
+        matched=pg, term_start_index=g, voter_mask=pg,
     )
 
 
@@ -64,7 +65,7 @@ def sharded_step(
     partitions trivially along G.
     """
     shardings = state_sharding(mesh, axis)
-    crashed_sh = NamedSharding(mesh, P(axis, None))
+    crashed_sh = NamedSharding(mesh, P(None, axis))
     append_sh = NamedSharding(mesh, P(axis))
     return jax.jit(
         functools.partial(sim.step, cfg),
@@ -92,9 +93,9 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
 
     def local(st: SimState):
         is_leader = st.state == ROLE_LEADER
-        has_leader = jnp.any(is_leader, axis=-1)
-        lead_commit = jnp.max(jnp.where(is_leader, st.commit, 0), axis=-1)
-        group_commit = jnp.max(st.commit, axis=-1)
+        has_leader = jnp.any(is_leader, axis=0)
+        lead_commit = jnp.max(jnp.where(is_leader, st.commit, 0), axis=0)
+        group_commit = jnp.max(st.commit, axis=0)
         n_leaders = jax.lax.psum(
             jnp.sum(has_leader.astype(jnp.int32)), axis_name=axis
         )
@@ -136,8 +137,8 @@ def run_sharded(
     st = shard_state(sim.init_state(cfg), mesh, axis)
     step_fn = sharded_step(cfg, mesh, axis)
     crashed = jax.device_put(
-        jnp.zeros((cfg.n_groups, cfg.n_peers), bool),
-        NamedSharding(mesh, P(axis, None)),
+        jnp.zeros((cfg.n_peers, cfg.n_groups), bool),
+        NamedSharding(mesh, P(None, axis)),
     )
     append = jax.device_put(
         jnp.ones((cfg.n_groups,), jnp.int32), NamedSharding(mesh, P(axis))

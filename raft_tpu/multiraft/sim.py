@@ -1,13 +1,22 @@
 """ClusterSim: closed-loop on-device simulation of G Raft groups × P peers.
 
 This is the intra-pod co-located-groups execution mode (SURVEY.md §5.8a):
-all P replicas of each group live in the same `[G, P]` device planes, so the
-entire message exchange of one protocol round — vote requests/responses,
-append broadcast and acks, heartbeats, commit propagation — reduces to array
-permutations and masked reductions.  One `step()` advances every group by one
-tick AND settles all resulting traffic, exactly like the scalar harness's
-"tick all peers, pump to quiescence" round (see simref.ScalarCluster, the
-parity oracle).
+all P replicas of each group live in the same device planes, so the entire
+message exchange of one protocol round — vote requests/responses, append
+broadcast and acks, heartbeats, commit propagation — reduces to array
+permutations and masked reductions.  One `step()` advances every group by
+one tick AND settles all resulting traffic, exactly like the scalar
+harness's "tick all peers, pump to quiescence" round (see
+simref.ScalarCluster, the parity oracle; the native C++ twin is
+cpp/multiraft_engine.cpp).
+
+TPU layout: every plane is **peer-major [P, G]** — the group axis lands on
+the 128-wide vector lanes (G is huge, P <= 8), so all elementwise work
+vectorizes fully; a [G, P] layout would waste 123/128 lanes.  The quorum
+"sort" is a fixed odd-even transposition network over the P rows (pure
+min/max of [G] vectors — no XLA variadic sort), and the whole election
+phase is gated behind a batch-level `lax.cond` so steady-state rounds pay
+only tick + replication + commit.
 
 Protocol scope of v1 (what BASELINE configs 2/3/5 need):
   * elections with randomized timeouts (counter PRNG keyed (node, term)),
@@ -19,21 +28,15 @@ Protocol scope of v1 (what BASELINE configs 2/3/5 need):
     keep ticking and campaigning but exchange no messages.
   Not modeled on device yet (host path handles them): pre-vote,
   check-quorum, joint reconfig mid-flight, snapshots, divergent log tails
-  (impossible under instant in-round replication — see maybe_append note).
-
-Faithfulness argument for logs: within a round every append reaches every
-alive peer and is acked (instant delivery, pump to quiescence), so an
-entry either reaches all alive peers or (its author having crashed at a
-round boundary) was never created.  Logs are therefore always prefixes of
-each other and `maybe_append` can never conflict — which is why last_index/
-last_term per peer is a sufficient log model and the conflict scan stays
-host-side (SURVEY.md §7 hard-part 3).
+  (impossible under instant in-round replication: within a round every
+  append reaches every alive peer, so logs stay prefixes of each other and
+  the maybe_append conflict scan stays host-side — SURVEY.md §7 hard-3).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,8 +64,8 @@ class SimConfig(NamedTuple):
 
 
 class SimState(NamedTuple):
-    """Device-resident SoA state, all [G, P] int32/bool (SURVEY.md §7
-    phase 4 state inventory)."""
+    """Device-resident SoA state, peer-major [P, G] int32/bool (SURVEY.md §7
+    phase-4 state inventory)."""
 
     term: jnp.ndarray
     state: jnp.ndarray  # ROLE_* codes
@@ -75,16 +78,16 @@ class SimState(NamedTuple):
     last_term: jnp.ndarray
     commit: jnp.ndarray
     # Group-level leader bookkeeping:
-    matched: jnp.ndarray  # [G, P] acting leader's Progress.matched view
+    matched: jnp.ndarray  # [P, G] acting leader's Progress.matched view
     term_start_index: jnp.ndarray  # [G] index of the leader's noop entry
-    voter_mask: jnp.ndarray  # [G, P] static config
+    voter_mask: jnp.ndarray  # [P, G] static config
 
 
 def _node_key(cfg: SimConfig) -> jnp.ndarray:
-    """node_key[g, p] = g * 2**16 + (p + 1): matches the scalar side's
+    """node_key[p, g] = g * 2**16 + (p + 1): matches the scalar side's
     Config.timeout_seed = g convention (util.deterministic_timeout)."""
-    g = jnp.arange(cfg.n_groups, dtype=jnp.uint32)[:, None]
-    p = jnp.arange(cfg.n_peers, dtype=jnp.uint32)[None, :]
+    g = jnp.arange(cfg.n_groups, dtype=jnp.uint32)[None, :]
+    p = jnp.arange(cfg.n_peers, dtype=jnp.uint32)[:, None]
     return g * jnp.uint32(1 << 16) + (p + 1)
 
 
@@ -92,7 +95,7 @@ def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimS
     """All peers start as followers at term 0 with their deterministic
     timeout draw (mirrors Raft.__init__ -> become_follower(0))."""
     G, P = cfg.n_groups, cfg.n_peers
-    shape = (G, P)
+    shape = (P, G)
 
     def zeros():
         # Distinct buffers per field: step() donates the whole state, and
@@ -121,6 +124,36 @@ def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimS
     )
 
 
+def _sort_rows_desc(rows: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Descending odd-even transposition sorting network over P rows of [G]
+    vectors: the TPU-friendly replacement for a variadic sort along the peer
+    axis (SURVEY.md §7 kernel k2)."""
+    n = len(rows)
+    rows = list(rows)
+    for pass_ in range(n):
+        for i in range(pass_ % 2, n - 1, 2):
+            hi = jnp.maximum(rows[i], rows[i + 1])
+            lo = jnp.minimum(rows[i], rows[i + 1])
+            rows[i], rows[i + 1] = hi, lo
+    return rows
+
+
+def _quorum_index(matched: jnp.ndarray, voter_mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-group majority commit index over the peer axis of [P, G] planes
+    (the scalar oracle: quorum.MajorityConfig.committed_index, reference:
+    majority.rs:70-124).  Returns int32[G]."""
+    P = matched.shape[0]
+    rows = _sort_rows_desc(
+        [jnp.where(voter_mask[p], matched[p], 0) for p in range(P)]
+    )
+    count = jnp.sum(voter_mask, axis=0).astype(jnp.int32)  # [G]
+    qpos = count // 2  # q - 1 = count//2+1-1
+    out = jnp.zeros_like(rows[0])
+    for p in range(P):
+        out = jnp.where(qpos == p, rows[p], out)
+    return jnp.where(count == 0, kernels.INF, out)
+
+
 def step(
     cfg: SimConfig,
     st: SimState,
@@ -129,18 +162,19 @@ def step(
 ) -> SimState:
     """One lockstep protocol round for every group.
 
-    crashed:  bool[G, P] peers isolated this round (keep ticking, no I/O)
+    crashed:  bool[P, G] peers isolated this round (keep ticking, no I/O)
     append_n: int32[G]   entries proposed at the group's leader this round
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
-    + (propose at leader) + (pump), expressed as four masked phases.
+    + (propose at leader) + (pump), expressed as masked phases; the election
+    phase is skipped wholesale when no peer campaigned this round.
     """
     G, P = cfg.n_groups, cfg.n_peers
-    self_id = jnp.arange(P, dtype=jnp.int32)[None, :] + 1
+    self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
     alive = ~crashed
     node_key = _node_key(cfg)
-    lo = jnp.full((G, P), cfg.min_timeout, jnp.int32)
-    hi = jnp.full((G, P), cfg.max_timeout, jnp.int32)
+    lo = jnp.full((P, G), cfg.min_timeout, jnp.int32)
+    hi = jnp.full((P, G), cfg.max_timeout, jnp.int32)
 
     def draw(term):
         return kernels.timeout_draw(node_key, term.astype(jnp.uint32), lo, hi)
@@ -157,166 +191,180 @@ def step(
         cfg.heartbeat_tick,
     )
 
-    # ---- Phase B: campaigners become candidates (reference: raft.rs
-    # become_candidate 1101-1117): term+1, vote self, redraw timeout.
+    # ---- Phase B: campaigners become candidates (reference:
+    # raft.rs:1101-1117): term+1, vote self, redraw timeout.
     term = st.term + want_campaign.astype(jnp.int32)
     state = jnp.where(want_campaign, ROLE_CANDIDATE, st.state)
     vote = jnp.where(want_campaign, self_id, st.vote)
     leader_id = jnp.where(want_campaign, 0, st.leader_id)
     rt = jnp.where(want_campaign, draw(term), st.randomized_timeout)
 
-    # ---- Phase C: election resolution among alive requesters.
-    # Only this round's campaigners broadcast MsgRequestVote (a pending
-    # candidate from an earlier round waits for its own next timeout).
+    # ---- Phase C: election resolution among alive requesters.  Only this
+    # round's campaigners broadcast MsgRequestVote (a pending candidate from
+    # an earlier round waits for its own next timeout).  The whole phase is
+    # skipped when nobody campaigned — the common steady-state case.
     req = want_campaign & alive
-    any_req = jnp.any(req, axis=-1)  # [G]
-    t_star = jnp.max(jnp.where(req, term, 0), axis=-1)  # [G]
 
-    # Receiving a higher-term request makes any alive peer a follower at
-    # that term with vote cleared (reference: raft.rs:1284-1348).
-    bump = alive & (term < t_star[:, None]) & any_req[:, None]
-    term_c = jnp.where(bump, t_star[:, None], term)
-    state_c = jnp.where(bump, ROLE_FOLLOWER, state)
-    vote_c = jnp.where(bump, 0, vote)
-    leader_c = jnp.where(bump, 0, leader_id)
-    ee = jnp.where(bump, 0, ee)
-    hb = jnp.where(bump, 0, hb)
-    rt = jnp.where(bump, draw(term_c), rt)
+    def election(args):
+        (term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts) = args
+        any_req = jnp.any(req, axis=0)  # [G]
+        t_star = jnp.max(jnp.where(req, term, 0), axis=0)  # [G]
 
-    # Candidates actually contending are requesters whose (pre-bump) term
-    # IS t_star; lower-term requesters just got deposed by the bump.
-    cand = req & (term == t_star[:, None])  # [G, P]
+        # Receiving a higher-term request makes any alive peer a follower at
+        # that term with vote cleared (reference: raft.rs:1284-1348).
+        bump = alive & (term < t_star) & any_req
+        term_c = jnp.where(bump, t_star, term)
+        state_c = jnp.where(bump, ROLE_FOLLOWER, state)
+        vote_c = jnp.where(bump, 0, vote)
+        leader_c = jnp.where(bump, 0, leader_id)
+        ee_c = jnp.where(bump, 0, ee)
+        hb_c = jnp.where(bump, 0, hb)
+        rt_c = jnp.where(bump, draw(term_c), rt)
 
-    # Vote decision per alive voter v (reference: raft.rs:1418-1461):
-    # can_vote (vote empty after bump) & candidate log up-to-date; ties in
-    # the same round resolve to the lowest peer index because the scalar
-    # pump delivers requests in peer order.
-    #   axes: [G, c, v]
-    lt_c = st.last_term[:, :, None]
-    li_c = st.last_index[:, :, None]
-    lt_v = st.last_term[:, None, :]
-    li_v = st.last_index[:, None, :]
-    up_to_date = (lt_c > lt_v) | ((lt_c == lt_v) & (li_c >= li_v))
-    elig = cand[:, :, None] & up_to_date  # candidate c eligible for voter v
+        # Candidates actually contending: requesters whose (pre-bump) term
+        # IS t_star; lower-term requesters just got deposed by the bump.
+        cand = req & (term == t_star)  # [P, G]
 
-    c_idx = jnp.arange(P, dtype=jnp.int32)[None, :, None]
-    first_elig = jnp.min(jnp.where(elig, c_idx, P), axis=1)  # [G, v]
-    # Voters respond only if alive, a voter, and at exactly t_star after the
-    # bump (peers with higher terms silently ignore stale requests).
-    responder = alive & st.voter_mask & (term_c == t_star[:, None]) & any_req[:, None]
-    can_vote = (vote_c == 0) & responder
-    grant_to = jnp.where(can_vote & (first_elig < P), first_elig, -1)  # [G, v]
+        # Vote decision per alive voter v (reference: raft.rs:1418-1461):
+        # can_vote (vote empty after bump) & candidate log up-to-date; ties
+        # resolve to the lowest peer index (scalar pump delivery order).
+        #   axes: [c, v, G]
+        lt_c = lt[:, None, :]
+        li_c = li[:, None, :]
+        lt_v = lt[None, :, :]
+        li_v = li[None, :, :]
+        up_to_date = (lt_c > lt_v) | ((lt_c == lt_v) & (li_c >= li_v))
+        elig = cand[:, None, :] & up_to_date
 
-    # votes_for[c] = grants + self-vote.
-    grants = jnp.sum(
-        (grant_to[:, None, :] == c_idx) & (grant_to[:, None, :] >= 0),
-        axis=-1,
-    ).astype(jnp.int32)
-    votes_for = grants + cand.astype(jnp.int32)
-    n_voters = jnp.sum(st.voter_mask, axis=-1).astype(jnp.int32)  # [G]
-    n_responders = jnp.sum(responder, axis=-1).astype(jnp.int32)
-    quorum = n_voters // 2 + 1
-    # Voters that never respond (crashed or ahead in term) are "missing".
-    missing = n_voters - n_responders
-    won = cand & (votes_for >= quorum[:, None])
-    lost = cand & (votes_for + missing[:, None] < quorum[:, None])
+        c_idx = jnp.arange(P, dtype=jnp.int32)[:, None, None]
+        first_elig = jnp.min(jnp.where(elig, c_idx, P), axis=0)  # [v, G]
+        # Voters respond only if alive, a voter, and at exactly t_star after
+        # the bump (peers with higher terms silently ignore stale requests).
+        responder = alive & st.voter_mask & (term_c == t_star) & any_req
+        can_vote = (vote_c == 0) & responder
+        grant_to = jnp.where(can_vote & (first_elig < P), first_elig, -1)
 
-    winner_exists = jnp.any(won, axis=-1)  # [G]
-    widx = jnp.argmax(won, axis=-1).astype(jnp.int32)  # [G]
+        # votes_for[c] = grants + self-vote.
+        grants = jnp.sum(
+            (grant_to[None, :, :] == c_idx) & (grant_to[None, :, :] >= 0),
+            axis=1,
+        ).astype(jnp.int32)
+        votes_for = grants + cand.astype(jnp.int32)
+        n_voters = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)  # [G]
+        n_responders = jnp.sum(responder, axis=0).astype(jnp.int32)
+        quorum = n_voters // 2 + 1
+        missing = n_voters - n_responders
+        won = cand & (votes_for >= quorum)
+        lost = cand & (votes_for + missing < quorum)
 
-    # Record granted votes (reference: raft.rs:1445-1449).
-    vote_c = jnp.where(grant_to >= 0, grant_to + 1, vote_c)
+        winner_exists = jnp.any(won, axis=0)  # [G]
 
-    # Winner becomes leader and appends its noop entry (reference:
-    # raft.rs:1151-1202); losers with a decided election step down.
-    is_winner = won  # at most one per group
-    new_last_index = jnp.where(is_winner, st.last_index + 1, st.last_index)
-    new_last_term = jnp.where(is_winner, t_star[:, None], st.last_term)
-    state_c = jnp.where(is_winner, ROLE_LEADER, state_c)
-    leader_c = jnp.where(is_winner, self_id, leader_c)
-    rt = jnp.where(is_winner, draw(term_c), rt)  # become_leader -> reset
-    ee = jnp.where(is_winner, 0, ee)
-    hb = jnp.where(is_winner, 0, hb)
-    # A losing candidate steps down when it sees the winner's append (same
-    # term) or a quorum of rejections (reference: raft.rs:2192-2197,
-    # 2215-2219).
-    step_down = cand & ~won & (lost | (winner_exists[:, None] & alive))
-    state_c = jnp.where(step_down, ROLE_FOLLOWER, state_c)
-    rt = jnp.where(step_down, draw(term_c), rt)
-    ee = jnp.where(step_down, 0, ee)
+        # Record granted votes (reference: raft.rs:1445-1449).
+        vote_c = jnp.where(grant_to >= 0, grant_to + 1, vote_c)
 
-    # New leader's tracker: matched = last for alive peers (they ack the
-    # noop in-round), 0 for crashed ones (probe state after reset;
-    # reference: raft.rs:942-971 + the in-round acks).
-    term_start = jnp.where(
-        winner_exists,
-        jnp.take_along_axis(new_last_index, widx[:, None], axis=1)[:, 0],
-        st.term_start_index,
+        # Winner becomes leader and appends its noop entry (reference:
+        # raft.rs:1151-1202); losers with a decided election step down.
+        li_n = jnp.where(won, li + 1, li)
+        lt_n = jnp.where(won, t_star, lt)
+        state_c = jnp.where(won, ROLE_LEADER, state_c)
+        leader_c = jnp.where(won, self_id, leader_c)
+        rt_c = jnp.where(won, draw(term_c), rt_c)
+        ee_c = jnp.where(won, 0, ee_c)
+        hb_c = jnp.where(won, 0, hb_c)
+        step_down = cand & ~won & (lost | (winner_exists & alive))
+        state_c = jnp.where(step_down, ROLE_FOLLOWER, state_c)
+        rt_c = jnp.where(step_down, draw(term_c), rt_c)
+        ee_c = jnp.where(step_down, 0, ee_c)
+
+        # New leader's tracker resets; alive peers ack the noop in-round
+        # (reference: raft.rs:942-971 + in-round acks).
+        noop_index = jnp.max(jnp.where(won, li_n, 0), axis=0)  # [G]
+        ts_n = jnp.where(winner_exists, noop_index, ts)
+        matched_n = jnp.where(winner_exists, 0, matched)
+        return (
+            term_c, state_c, vote_c, leader_c, ee_c, hb_c, rt_c,
+            li_n, lt_n, matched_n, ts_n, winner_exists,
+        )
+
+    def no_election(args):
+        (term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts) = args
+        return (
+            term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts,
+            jnp.zeros((G,), bool),
+        )
+
+    (
+        term, state, vote, leader_id, ee, hb, rt,
+        new_last_index, new_last_term, matched, term_start, winner_exists,
+    ) = jax.lax.cond(
+        jnp.any(req),
+        election,
+        no_election,
+        (
+            term, state, vote, leader_id, ee, hb, rt,
+            st.last_index, st.last_term, st.matched, st.term_start_index,
+        ),
     )
 
     # ---- Phase D: replication round for groups with an alive leader.
-    is_leader = (state_c == ROLE_LEADER) & alive
-    has_leader = jnp.any(is_leader, axis=-1)  # [G]
+    is_leader = (state == ROLE_LEADER) & alive
+    has_leader = jnp.any(is_leader, axis=0)  # [G]
     # The acting leader is the alive leader with the highest term (a stale
     # recovered leader loses this and gets synced down below).
-    lead_score = jnp.where(is_leader, term_c, -1)
-    lidx = jnp.argmax(lead_score, axis=-1).astype(jnp.int32)  # [G]
-    lead_term = jnp.take_along_axis(term_c, lidx[:, None], axis=1)[:, 0]
+    lead_score = jnp.where(is_leader, term, -1)  # [P, G]
+    lead_term = jnp.max(lead_score, axis=0)  # [G]
+    # lowest peer index among max-term alive leaders (unique in practice)
+    is_acting = is_leader & (term == lead_term)
+    first_l = jnp.min(
+        jnp.where(is_acting, jnp.arange(P, dtype=jnp.int32)[:, None], P), axis=0
+    )  # [G]
+    is_acting_leader = (jnp.arange(P, dtype=jnp.int32)[:, None] == first_l) & has_leader
 
     # Append workload at the leader (entries stamped with its term).
     n_app = jnp.where(has_leader, append_n, 0)  # [G]
-    is_acting_leader = (
-        jnp.arange(P, dtype=jnp.int32)[None, :] == lidx[:, None]
-    ) & has_leader[:, None]
-    new_last_index = new_last_index + jnp.where(is_acting_leader, n_app[:, None], 0)
-    new_last_term = jnp.where(is_acting_leader, lead_term[:, None], new_last_term)
+    new_last_index = new_last_index + jnp.where(is_acting_leader, n_app, 0)
+    new_last_term = jnp.where(is_acting_leader, lead_term, new_last_term)
 
-    lead_last = jnp.take_along_axis(new_last_index, lidx[:, None], axis=1)[:, 0]
-    lead_last_term = jnp.take_along_axis(new_last_term, lidx[:, None], axis=1)[:, 0]
+    lead_last = jnp.max(jnp.where(is_acting_leader, new_last_index, 0), axis=0)
+    lead_last_term = jnp.max(
+        jnp.where(is_acting_leader, new_last_term, 0), axis=0
+    )
 
     # Did the leader send anything this round?  Heartbeats (every
     # heartbeat_tick), the election noop, or workload appends.
-    lead_beat = jnp.take_along_axis(
-        want_heartbeat | is_winner, lidx[:, None], axis=1
-    )[:, 0]
+    lead_beat = jnp.any(want_heartbeat & is_acting_leader, axis=0)
     sent = has_leader & (lead_beat | (n_app > 0) | winner_exists)
 
     # Peers that sync to the leader this round: alive, reachable terms
     # (term <= leader's — higher-term peers ignore), not the leader itself.
-    sync = (
-        sent[:, None]
-        & alive
-        & (term_c <= lead_term[:, None])
-        & ~is_acting_leader
-    )
-    term_bumped = sync & (term_c < lead_term[:, None])
-    term_d = jnp.where(sync, lead_term[:, None], term_c)
-    state_d = jnp.where(sync, ROLE_FOLLOWER, state_c)
-    vote_d = jnp.where(term_bumped, 0, vote_c)
-    leader_d = jnp.where(sync, lidx[:, None] + 1, leader_c)
+    sync = sent & alive & (term <= lead_term) & ~is_acting_leader
+    term_bumped = sync & (term < lead_term)
+    term_d = jnp.where(sync, lead_term, term)
+    state_d = jnp.where(sync, ROLE_FOLLOWER, state)
+    vote_d = jnp.where(term_bumped, 0, vote)
+    leader_d = jnp.where(sync, first_l + 1, leader_id)
     ee = jnp.where(sync, 0, ee)
     rt = jnp.where(term_bumped, draw(term_d), rt)
     # Followers adopt the leader's log wholesale (prefix property).
-    new_last_index = jnp.where(sync, lead_last[:, None], new_last_index)
-    new_last_term = jnp.where(sync, lead_last_term[:, None], new_last_term)
+    new_last_index = jnp.where(sync, lead_last, new_last_index)
+    new_last_term = jnp.where(sync, lead_last_term, new_last_term)
 
-    # Leader's matched view: reset on election, then acks from every synced
-    # peer + its own persisted tail.
-    matched = jnp.where(winner_exists[:, None], 0, st.matched)
+    # Leader's matched view: acks from every synced peer + its own tail.
     matched = jnp.where(sync | is_acting_leader, new_last_index, matched)
 
     # Quorum commit, gated on the entry being from the leader's own term
     # (raft_log.maybe_commit's term check; reference: raft_log.rs:487-499 —
-    # mci >= term_start_index iff term(mci) == lead_term, by log monotonicity).
-    mci = kernels.committed_index(matched, st.voter_mask)
+    # mci >= term_start_index iff term(mci) == lead_term, by log
+    # monotonicity).
+    mci = _quorum_index(matched, st.voter_mask)
     commit_ok = has_leader & (mci >= term_start) & (mci < kernels.INF)
-    lead_commit_old = jnp.take_along_axis(st.commit, lidx[:, None], axis=1)[:, 0]
+    lead_commit_old = jnp.max(jnp.where(is_acting_leader, st.commit, 0), axis=0)
     lead_commit = jnp.where(
         commit_ok, jnp.maximum(lead_commit_old, mci), lead_commit_old
     )
-    commit = jnp.where(is_acting_leader, lead_commit[:, None], st.commit)
+    commit = jnp.where(is_acting_leader, lead_commit, st.commit)
     # Synced followers learn min(leader commit, their last) = leader commit.
-    commit = jnp.where(sync, lead_commit[:, None], commit)
+    commit = jnp.where(sync, lead_commit, commit)
 
     return SimState(
         term=term_d,
@@ -336,7 +384,9 @@ def step(
 
 
 class ClusterSim:
-    """Convenience wrapper: jitted step + host-friendly runners."""
+    """Convenience wrapper: jitted step + host-friendly runners.  Arrays are
+    peer-major [P, G]; `snapshot_gp()` returns the [G, P] view for parity
+    comparisons."""
 
     def __init__(self, cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None):
         self.cfg = cfg
@@ -346,7 +396,7 @@ class ClusterSim:
     def run_round(self, crashed=None, append_n=None) -> SimState:
         G, P = self.cfg.n_groups, self.cfg.n_peers
         if crashed is None:
-            crashed = jnp.zeros((G, P), bool)
+            crashed = jnp.zeros((P, G), bool)
         if append_n is None:
             append_n = jnp.zeros((G,), jnp.int32)
         self.state = self._step(self.state, crashed, append_n)

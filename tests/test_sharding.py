@@ -23,7 +23,7 @@ def test_sharded_step_matches_single_device():
     st_sharded = sharding.shard_state(init_state(cfg), mesh)
     sim = ClusterSim(cfg)
 
-    crashed = jnp.zeros((cfg.n_groups, cfg.n_peers), bool)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
     for r in range(30):
         st_sharded = step_fn(st_sharded, crashed, append)

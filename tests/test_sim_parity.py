@@ -16,12 +16,13 @@ FIELDS = ("term", "state", "commit", "last_index", "last_term")
 
 
 def device_snapshot(state):
+    # SimState is peer-major [P, G]; the scalar snapshots are [G, P].
     return {
-        "term": np.asarray(state.term, dtype=np.int64),
-        "state": np.asarray(state.state, dtype=np.int64),
-        "commit": np.asarray(state.commit, dtype=np.int64),
-        "last_index": np.asarray(state.last_index, dtype=np.int64),
-        "last_term": np.asarray(state.last_term, dtype=np.int64),
+        "term": np.asarray(state.term, dtype=np.int64).T,
+        "state": np.asarray(state.state, dtype=np.int64).T,
+        "commit": np.asarray(state.commit, dtype=np.int64).T,
+        "last_index": np.asarray(state.last_index, dtype=np.int64).T,
+        "last_term": np.asarray(state.last_term, dtype=np.int64).T,
     }
 
 
@@ -32,7 +33,7 @@ def run_parity(G, P, rounds, schedule, seed_note=""):
     for r in range(rounds):
         crashed, append = schedule(r)
         scalar.round(crashed, append)
-        sim.run_round(jnp.asarray(crashed), jnp.asarray(append, dtype=jnp.int32))
+        sim.run_round(jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32))
         want = scalar.snapshot()
         got = device_snapshot(sim.state)
         for f in FIELDS:
