@@ -1,0 +1,251 @@
+"""MultiRaft: the batched host driver for G raft groups on one node
+(the BASELINE.json north star's `MultiRaft<S: Storage>` alongside RawNode).
+
+A TiKV-style multi-raft node is one peer of each of G groups.  The naive
+driver calls `RawNode.tick()` G times per tick interval — an O(G) Python/
+branching loop that dominates CPU at 100k groups even when nothing happens.
+Here the per-group timer state {state, election_elapsed, heartbeat_elapsed,
+randomized_timeout, promotable} is mirrored into device-resident [G] arrays
+and one fused `tick_kernel` advances every group per tick; the host then
+touches ONLY the groups whose masks fired (want_campaign / want_heartbeat /
+election-timeout boundary) plus groups with inbound traffic — the Zipf
+sparsity BASELINE config #3 banks on.
+
+Consistency contract: the device owns the timers between host events; any
+host interaction with a group (messages, proposals, Ready handling) is
+bracketed by `_sync_to_node` / `_sync_from_node`, which gather/scatter that
+group's row so the scalar RawNode sees exactly the counters `Raft.tick()`
+would have produced (reference: raft.rs:1024-1079 tick semantics, including
+the leader's election-timeout boundary effects: check-quorum step and
+leader-transfer abort, raft.rs:1056-1065).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..eraftpb import Message, MessageType
+from ..raft import StateRole, new_message
+from ..raw_node import RawNode
+from ..storage import Storage
+from . import kernels
+
+
+class MultiRaft:
+    """G RawNodes with device-batched tick timers."""
+
+    def __init__(
+        self,
+        base_config: Config,
+        storages: Sequence[Storage],
+        group_seeds: Optional[Sequence[int]] = None,
+    ):
+        self.G = len(storages)
+        self.nodes: List[RawNode] = []
+        for g, store in enumerate(storages):
+            cfg = Config(**{**base_config.__dict__})
+            cfg.timeout_seed = (
+                group_seeds[g] if group_seeds is not None else g
+            )
+            self.nodes.append(RawNode(cfg, store))
+        self.election_tick = base_config.election_tick
+        self.heartbeat_tick = base_config.heartbeat_tick
+
+        # Device mirrors [G].
+        self._d = {
+            "state": jnp.asarray(
+                np.array([n.raft.state for n in self.nodes], np.int32)
+            ),
+            "ee": jnp.asarray(
+                np.array([n.raft.election_elapsed for n in self.nodes], np.int32)
+            ),
+            "hb": jnp.asarray(
+                np.array(
+                    [n.raft.heartbeat_elapsed for n in self.nodes], np.int32
+                )
+            ),
+            "rt": jnp.asarray(
+                np.array(
+                    [n.raft.randomized_election_timeout for n in self.nodes],
+                    np.int32,
+                )
+            ),
+            "promotable": jnp.asarray(
+                np.array([n.raft.promotable for n in self.nodes], bool)
+            ),
+        }
+
+        et, ht = self.election_tick, self.heartbeat_tick
+
+        @jax.jit
+        def _tick(d):
+            ee, hb, campaign, beat, checkq = kernels.tick_kernel(
+                d["state"], d["ee"], d["hb"], d["rt"], d["promotable"], et, ht
+            )
+            out = dict(d)
+            out["ee"] = ee
+            out["hb"] = hb
+            return out, campaign, beat, checkq
+
+        self._tick_fn = _tick
+
+    # --- host<->device row sync ---
+
+    def _sync_to_node(self, g: int, ee_row: int, hb_row: int) -> None:
+        r = self.nodes[g].raft
+        r.election_elapsed = int(ee_row)
+        r.heartbeat_elapsed = int(hb_row)
+
+    def _sync_from_nodes(self, groups: Iterable[int]) -> None:
+        groups = list(groups)
+        if not groups:
+            return
+        idx = jnp.asarray(np.asarray(groups, np.int32))
+        vals = {
+            "state": np.array(
+                [self.nodes[g].raft.state for g in groups], np.int32
+            ),
+            "ee": np.array(
+                [self.nodes[g].raft.election_elapsed for g in groups], np.int32
+            ),
+            "hb": np.array(
+                [self.nodes[g].raft.heartbeat_elapsed for g in groups], np.int32
+            ),
+            "rt": np.array(
+                [self.nodes[g].raft.randomized_election_timeout for g in groups],
+                np.int32,
+            ),
+            "promotable": np.array(
+                [self.nodes[g].raft.promotable for g in groups], bool
+            ),
+        }
+        for k, v in vals.items():
+            self._d[k] = self._d[k].at[idx].set(jnp.asarray(v))
+
+    # --- the batched tick (SURVEY.md §7 kernel k1 in production shape) ---
+
+    def tick(self) -> np.ndarray:
+        """Advance every group's logical clock by one tick on device;
+        dispatch tick side effects on the host only for fired groups.
+        Returns the boolean [G] mask of groups with probable readiness."""
+        self._d, campaign, beat, checkq = self._tick_fn(self._d)
+        campaign = np.asarray(campaign)
+        beat = np.asarray(beat)
+        checkq = np.asarray(checkq)
+        active = campaign | beat | checkq
+        if not active.any():
+            return active
+        idx = np.nonzero(active)[0]
+        ee = np.asarray(jnp.take(self._d["ee"], jnp.asarray(idx)))
+        hb = np.asarray(jnp.take(self._d["hb"], jnp.asarray(idx)))
+        touched = []
+        for row, g in enumerate(idx):
+            g = int(g)
+            node = self.nodes[g]
+            r = node.raft
+            self._sync_to_node(g, ee[row], hb[row])
+            if campaign[g]:
+                # tick_election fired (reference: raft.rs:1037-1047).
+                try:
+                    r.step(new_message(0, MessageType.MsgHup, r.id))
+                except Exception:
+                    pass
+            if checkq[g]:
+                # Leader election-timeout boundary (reference:
+                # raft.rs:1056-1065): check-quorum + transfer abort.
+                if r.check_quorum:
+                    try:
+                        r.step(new_message(0, MessageType.MsgCheckQuorum, r.id))
+                    except Exception:
+                        pass
+                if r.state == StateRole.Leader and r.lead_transferee is not None:
+                    r.abort_leader_transfer()
+            if beat[g] and r.state == StateRole.Leader:
+                try:
+                    r.step(new_message(0, MessageType.MsgBeat, r.id))
+                except Exception:
+                    pass
+            touched.append(g)
+        self._sync_from_nodes(touched)
+        return active
+
+    # --- host-side per-group interactions (all bracketed by sync) ---
+
+    def _host_op(self, g: int, fn: Callable[[RawNode], object]):
+        ee = int(self._d["ee"][g])
+        hb = int(self._d["hb"][g])
+        self._sync_to_node(g, ee, hb)
+        try:
+            return fn(self.nodes[g])
+        finally:
+            self._sync_from_nodes([g])
+
+    def step(self, g: int, m: Message) -> None:
+        self._host_op(g, lambda n: n.step(m))
+
+    def step_batch(self, msgs: Iterable[Tuple[int, Message]]) -> None:
+        """Deliver a batch of (group, message) pairs with ONE gather/scatter
+        for all touched groups (the DCN inbox path, SURVEY.md §5.8b)."""
+        by_group: Dict[int, List[Message]] = {}
+        for g, m in msgs:
+            by_group.setdefault(g, []).append(m)
+        if not by_group:
+            return
+        groups = sorted(by_group)
+        gidx = jnp.asarray(np.asarray(groups, np.int32))
+        ee = np.asarray(jnp.take(self._d["ee"], gidx))
+        hb = np.asarray(jnp.take(self._d["hb"], gidx))
+        for row, g in enumerate(groups):
+            self._sync_to_node(g, ee[row], hb[row])
+            for m in by_group[g]:
+                try:
+                    self.nodes[g].step(m)
+                except Exception:
+                    pass
+        self._sync_from_nodes(groups)
+
+    def propose(self, g: int, context: bytes, data: bytes) -> None:
+        self._host_op(g, lambda n: n.propose(context, data))
+
+    def campaign(self, g: int) -> None:
+        self._host_op(g, lambda n: n.campaign())
+
+    def has_ready(self, g: int) -> bool:
+        return self.nodes[g].has_ready()
+
+    def ready_groups(self) -> List[int]:
+        return [g for g, n in enumerate(self.nodes) if n.has_ready()]
+
+    def ready(self, g: int):
+        return self._host_op(g, lambda n: n.ready())
+
+    def advance(self, g: int, rd):
+        return self._host_op(g, lambda n: n.advance(rd))
+
+    def advance_apply(self, g: int) -> None:
+        self._host_op(g, lambda n: n.advance_apply())
+
+    def node(self, g: int) -> RawNode:
+        return self.nodes[g]
+
+    # --- batched introspection (SURVEY.md §5.5 MultiRaftStatus) ---
+
+    def status(self) -> Dict[str, int]:
+        states = np.array([n.raft.state for n in self.nodes], np.int32)
+        commits = np.array(
+            [n.raft.raft_log.committed for n in self.nodes], np.int64
+        )
+        terms = np.array([n.raft.term for n in self.nodes], np.int64)
+        return {
+            "n_groups": self.G,
+            "n_leaders": int((states == StateRole.Leader).sum()),
+            "n_candidates": int((states == StateRole.Candidate).sum()),
+            "min_commit": int(commits.min()) if self.G else 0,
+            "total_commit": int(commits.sum()),
+            "max_term": int(terms.max()) if self.G else 0,
+        }
