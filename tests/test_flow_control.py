@@ -1,0 +1,88 @@
+"""Inflights window flow-control tests (ported behaviors from reference:
+harness/tests/integration_cases/test_raft_flow_control.rs)."""
+
+from raft_tpu import MessageType
+
+from test_util import new_message, new_test_raft
+
+
+def leader_with_replicating_follower():
+    r = new_test_raft(1, [1, 2], 5, 1)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    # force the progress into replicate state
+    r.raft.prs.get_mut(2).become_replicate()
+    return r
+
+
+def test_msg_app_flow_control_full():
+    r = leader_with_replicating_follower()
+    # fill in the inflights window
+    for i in range(r.raft.max_inflight):
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        ms = r.read_messages()
+        assert len(ms) == 1, f"#{i}: {len(ms)}"
+
+    assert r.raft.prs.get(2).ins.full()
+
+    # window full: no more MsgAppend
+    for i in range(10):
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        assert r.read_messages() == [], f"#{i}"
+
+
+def test_msg_app_flow_control_move_forward():
+    r = leader_with_replicating_follower()
+    for _ in range(r.raft.max_inflight):
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        r.read_messages()
+
+    # 1 is the noop, 2 the first proposal; start there.
+    for tt in range(2, r.raft.max_inflight):
+        # move the window forward
+        m = new_message(2, 1, MessageType.MsgAppendResponse)
+        m.index = tt
+        r.step(m)
+        r.read_messages()
+
+        # refill
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        ms = r.read_messages()
+        assert len(ms) == 1, f"#{tt}: {len(ms)}"
+        assert r.raft.prs.get(2).ins.full(), f"#{tt}"
+
+        # out-of-date acks don't move the window
+        for i in range(tt):
+            m = new_message(2, 1, MessageType.MsgAppendResponse)
+            m.index = i
+            r.step(m)
+            assert r.raft.prs.get(2).ins.full(), f"#{tt}.{i}"
+
+
+def test_msg_app_flow_control_recv_heartbeat():
+    r = leader_with_replicating_follower()
+    for _ in range(r.raft.max_inflight):
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        r.read_messages()
+
+    for tt in range(1, 5):
+        assert r.raft.prs.get(2).ins.full(), f"#{tt}"
+
+        # each heartbeat response frees exactly one slot
+        for i in range(tt):
+            r.step(new_message(2, 1, MessageType.MsgHeartbeatResponse))
+            r.read_messages()
+            assert not r.raft.prs.get(2).ins.full(), f"#{tt}.{i}"
+
+        # one proposal fits
+        r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        assert len(r.read_messages()) == 1, f"#{tt}"
+
+        # ...and only one
+        for i in range(10):
+            r.step(new_message(1, 1, MessageType.MsgPropose, 1))
+            assert r.read_messages() == [], f"#{tt}.{i}"
+
+        # clear pending
+        r.step(new_message(2, 1, MessageType.MsgHeartbeatResponse))
+        r.read_messages()

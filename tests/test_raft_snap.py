@@ -1,0 +1,174 @@
+"""Snapshot-state pause/resume + follower-requested snapshots (ported
+behaviors from reference: harness/tests/integration_cases/test_raft_snap.rs)."""
+
+import pytest
+
+from raft_tpu import (
+    MemStorage,
+    MessageType,
+    ProgressState,
+    RequestSnapshotDropped,
+)
+from raft_tpu.harness import Network
+
+from test_util import (
+    new_message,
+    new_snapshot,
+    new_storage,
+    new_test_raft,
+    new_test_raft_with_prevote,
+)
+
+
+def make_testing_snap():
+    return new_snapshot(11, 11, [1, 2])
+
+
+def restored_leader():
+    sm = new_test_raft(1, [1, 2], 10, 1)
+    sm.raft.restore(make_testing_snap())
+    sm.persist()
+    sm.raft.become_candidate()
+    sm.raft.become_leader()
+    return sm
+
+
+def test_sending_snapshot_set_pending_snapshot():
+    sm = restored_leader()
+    # force node 2's next back so it needs a snapshot
+    sm.raft.prs.get_mut(2).next_idx = sm.raft_log.first_index()
+
+    m = new_message(2, 1, MessageType.MsgAppendResponse)
+    m.index = sm.raft.prs.get(2).next_idx - 1
+    m.reject = True
+    sm.step(m)
+    assert sm.raft.prs.get(2).pending_snapshot == 11
+
+
+def test_pending_snapshot_pause_replication():
+    sm = restored_leader()
+    sm.raft.prs.get_mut(2).become_snapshot(11)
+
+    sm.step(new_message(1, 1, MessageType.MsgPropose, 1))
+    assert sm.read_messages() == []
+
+
+def test_snapshot_failure():
+    sm = restored_leader()
+    sm.raft.prs.get_mut(2).next_idx = 1
+    sm.raft.prs.get_mut(2).become_snapshot(11)
+
+    m = new_message(2, 1, MessageType.MsgSnapStatus)
+    m.reject = True
+    sm.step(m)
+    pr = sm.raft.prs.get(2)
+    assert pr.pending_snapshot == 0
+    assert pr.next_idx == 1
+    assert pr.paused
+
+
+def test_snapshot_succeed():
+    sm = restored_leader()
+    sm.raft.prs.get_mut(2).next_idx = 1
+    sm.raft.prs.get_mut(2).become_snapshot(11)
+
+    m = new_message(2, 1, MessageType.MsgSnapStatus)
+    m.reject = False
+    sm.step(m)
+    pr = sm.raft.prs.get(2)
+    assert pr.pending_snapshot == 0
+    assert pr.next_idx == 12
+    assert pr.paused
+
+
+def test_snapshot_abort():
+    sm = restored_leader()
+    sm.raft.prs.get_mut(2).next_idx = 1
+    sm.raft.prs.get_mut(2).become_snapshot(11)
+
+    # an ack at/above pending_snapshot aborts the snapshot
+    m = new_message(2, 1, MessageType.MsgAppendResponse)
+    m.index = 11
+    sm.step(m)
+    assert sm.raft.prs.get(2).pending_snapshot == 0
+    assert sm.raft.prs.get(2).next_idx == 12
+
+
+@pytest.mark.parametrize("pre_vote", [True, False])
+def test_snapshot_with_min_term(pre_vote):
+    s = new_storage()
+    with s.wl() as core:
+        core.apply_snapshot(new_snapshot(1, 1, [1, 2]))
+    n1 = new_test_raft_with_prevote(1, [1, 2], 10, 1, s, pre_vote)
+    n2 = new_test_raft_with_prevote(2, [], 10, 1, new_storage(), pre_vote)
+    nt = Network.new([n1, n2])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    # 1 is elected and brings 2 up via snapshot + the empty entry.
+    assert nt.peers[2].raft_log.first_index() == 2
+    assert nt.peers[2].raft_log.last_index() == 2
+
+
+def test_request_snapshot():
+    sm = new_test_raft(1, [1, 2], 10, 1)
+    sm.raft.restore(make_testing_snap())
+    sm.persist()
+
+    # no leader: request dropped
+    with pytest.raises(RequestSnapshotDropped):
+        sm.raft.request_snapshot(1)
+
+    sm.raft.become_candidate()
+    sm.raft.become_leader()
+
+    # leaders can't request snapshots
+    with pytest.raises(RequestSnapshotDropped):
+        sm.raft.request_snapshot(1)
+
+    # advance matched
+    m = new_message(2, 1, MessageType.MsgAppendResponse)
+    m.index = 11
+    sm.step(m)
+    assert sm.raft.prs.get(2).state == ProgressState.Replicate
+
+    request_snapshot_idx = sm.raft_log.committed
+    m = new_message(2, 1, MessageType.MsgAppendResponse)
+    m.index = 11
+    m.reject = True
+    m.reject_hint = 0
+    m.request_snapshot = request_snapshot_idx
+
+    # out-of-order request snapshot messages are ignored
+    out_of_order = new_message(2, 1, MessageType.MsgAppendResponse)
+    out_of_order.index = 9
+    out_of_order.reject = True
+    out_of_order.reject_hint = 0
+    out_of_order.request_snapshot = request_snapshot_idx
+    sm.step(out_of_order)
+    assert sm.raft.prs.get(2).state == ProgressState.Replicate
+
+    # the request triggers a snapshot send
+    sm.step(m)
+    pr = sm.raft.prs.get(2)
+    assert pr.state == ProgressState.Snapshot
+    assert pr.pending_snapshot == 11
+    assert pr.next_idx == 12
+    assert pr.is_paused()
+    snap_msg = sm.raft.msgs.pop()
+    assert snap_msg.msg_type == MessageType.MsgSnapshot
+    assert snap_msg.snapshot.metadata.index == request_snapshot_idx
+
+    # append responses do not leave Snapshot state
+    m = new_message(2, 1, MessageType.MsgAppendResponse)
+    m.index = 11
+    sm.step(m)
+    pr = sm.raft.prs.get(2)
+    assert pr.state == ProgressState.Snapshot
+    assert pr.pending_snapshot == 11
+
+    # ...but a snapshot status report does
+    sm.step(new_message(2, 1, MessageType.MsgSnapStatus))
+    pr = sm.raft.prs.get(2)
+    assert pr.state == ProgressState.Probe
+    assert pr.pending_snapshot == 0
+    assert pr.next_idx == 12
+    assert pr.is_paused()
