@@ -1,0 +1,191 @@
+"""Micro-benchmark suites (the Criterion-suite equivalent; reference:
+benches/suites/{raft,raw_node,progress}.rs) plus the five BASELINE.json
+multi-group configs.
+
+Run: python benches/suites.py [--quick]
+Prints a table of results; bench.py remains the single-line headline bench.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from raft_tpu import Config, Entry, MemStorage, Message, MessageType, Raft, RawNode
+from raft_tpu.raft import CAMPAIGN_ELECTION, CAMPAIGN_PRE_ELECTION, CAMPAIGN_TRANSFER
+from raft_tpu.raft_log import NO_LIMIT
+from raft_tpu.tracker import Progress
+
+
+def timeit(fn, iters):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    dt = time.perf_counter() - t0
+    return dt / iters
+
+
+def quick_raw_node(voters, learners):
+    ids = list(range(1, voters + 1))
+    learner_ids = list(range(voters + 1, voters + learners + 1))
+    storage = MemStorage()
+    storage.initialize_with_conf_state((ids or [1], learner_ids))
+    cfg = Config(
+        id=1,
+        election_tick=10,
+        heartbeat_tick=1,
+        max_size_per_msg=NO_LIMIT,
+        max_inflight_msgs=256,
+    )
+    return RawNode(cfg, storage)
+
+
+def bench_raft_new(results, iters):
+    """reference: benches/suites/raft.rs:30-38"""
+    for voters, learners in [(0, 0), (3, 1), (5, 2), (7, 3)]:
+        if voters == 0:
+            continue
+        t = timeit(lambda: quick_raw_node(voters, learners).raft, iters)
+        results.append((f"Raft::new ({voters}, {learners})", t * 1e6, "us/op"))
+
+
+def bench_campaign(results, iters):
+    """reference: benches/suites/raft.rs:40-66"""
+    for voters, learners in [(3, 1), (5, 2), (7, 3)]:
+        for ct, name in [
+            (CAMPAIGN_PRE_ELECTION, "PreElection"),
+            (CAMPAIGN_ELECTION, "Election"),
+            (CAMPAIGN_TRANSFER, "Transfer"),
+        ]:
+            def run():
+                node = quick_raw_node(voters, learners)
+                node.raft.campaign(ct)
+
+            t = timeit(run, iters)
+            results.append(
+                (f"campaign ({voters},{learners}) {name}", t * 1e6, "us/op")
+            )
+
+
+def bench_leader_propose(results, iters):
+    """reference: benches/suites/raw_node.rs:35-79"""
+    for size in [0, 32, 128, 512, 1024, 4096, 16384, 131072, 524288, 1048576]:
+        node = quick_raw_node(1, 0)
+        node.campaign()
+        while node.has_ready():
+            rd = node.ready()
+            with node.store.wl() as core:
+                core.append(rd.entries)
+                if rd.hs is not None:
+                    core.set_hardstate(rd.hs.clone())
+            node.advance(rd)
+            node.advance_apply()
+        data = b"x" * size
+        n = max(1, min(iters, 2_000_000 // max(size, 1)))
+
+        def run():
+            node.propose(b"", data)
+
+        t = timeit(run, n)
+        mbps = size / t / 1e6 if t > 0 and size else 0
+        results.append((f"leader_propose {size}B", t * 1e6, f"us/op ({mbps:.0f} MB/s)"))
+
+
+def bench_new_ready(results, iters):
+    """Loaded-node ready (reference: benches/suites/raw_node.rs:81-141
+    fixture: 100 appended + 100 committed 32KiB entries + messages)."""
+    def setup():
+        node = quick_raw_node(3, 0)
+        node.raft.become_candidate()
+        node.raft.become_leader()
+        ents = [Entry(data=b"x" * 32 * 1024) for _ in range(100)]
+        assert node.raft.append_entry(ents)
+        return node
+
+    node = setup()
+
+    def run():
+        if node.has_ready():
+            rd = node.ready()
+            with node.store.wl() as core:
+                core.append(rd.entries)
+            node.advance(rd)
+
+    t = timeit(run, max(1, iters // 10))
+    results.append(("RawNode::ready loaded", t * 1e6, "us/op"))
+
+
+def bench_progress_new(results, iters):
+    """reference: benches/suites/progress.rs:10-17"""
+    t = timeit(lambda: Progress(9, 10), iters * 10)
+    results.append(("Progress::new", t * 1e9, "ns/op"))
+
+
+def bench_baseline_configs(results, quick):
+    """The five BASELINE.json multi-group configs on whatever JAX device is
+    active (TPU under the driver, CPU elsewhere)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.multiraft import sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    configs = [
+        ("config2: 1k x 3 uniform", 1_000, 3, 1),
+        ("config3: 100k x 5 zipf-ish", 100_000, 5, 1),
+        ("config5: 1M x 3 storm", 1_000_000, 3, 0),
+    ]
+    if quick:
+        configs = configs[:1]
+    rounds = 50
+    for name, G, P, app in configs:
+        cfg = SimConfig(n_groups=G, n_peers=P)
+        st = sim.init_state(cfg)
+        crashed = jnp.zeros((P, G), bool)
+        append = jnp.full((G,), app, jnp.int32)
+        step = functools.partial(sim.step, cfg)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def multi(st, crashed=crashed, append=append, step=step):
+            def body(s, _):
+                return step(s, crashed, append), ()
+
+            return jax.lax.scan(body, st, None, length=rounds)[0]
+
+        st = multi(st)
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        st = multi(st)
+        jax.block_until_ready(st)
+        dt = time.perf_counter() - t0
+        results.append((name, G * rounds / dt / 1e6, "M ticks/s"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    iters = 50 if args.quick else 300
+
+    results = []
+    bench_raft_new(results, iters)
+    bench_campaign(results, max(10, iters // 10))
+    bench_leader_propose(results, iters)
+    bench_new_ready(results, iters)
+    bench_progress_new(results, iters)
+    bench_baseline_configs(results, args.quick)
+
+    width = max(len(n) for n, _, _ in results)
+    print(f"{'benchmark':<{width}}  value")
+    print("-" * (width + 24))
+    for name, value, unit in results:
+        print(f"{name:<{width}}  {value:>12.2f} {unit}")
+
+
+if __name__ == "__main__":
+    main()

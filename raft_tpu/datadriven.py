@@ -40,6 +40,8 @@ class CmdArg:
 class TestData:
     """One directive block (reference: datadriven/src/test_data.rs:95)."""
 
+    __test__ = False  # not a pytest class despite the name
+
     pos: str = ""
     cmd: str = ""
     cmd_args: List[CmdArg] = field(default_factory=list)
