@@ -1,0 +1,69 @@
+"""Checkpoint / resume for the batched MultiRaft device state
+(SURVEY.md §5.4: HardState-style persistence adapted to the [P, G] planes).
+
+The scalar path persists through the Ready protocol (HardState + entries via
+the application's Storage, reference: raw_node.rs must_sync semantics).  The
+device path's equivalent is a whole-batch snapshot: every SimState plane is
+downloaded once and written as a single .npz; because every backend is
+deterministic, a resumed run is bit-identical to an uninterrupted one
+(tested in tests/test_checkpoint.py).
+
+For the per-group HardState view (what the reference would fsync), use
+`hard_states()`: {term, vote, commit}[P, G] extracted from the planes.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+from typing import Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from .sim import SimConfig, SimState
+
+_FORMAT_VERSION = 1
+
+
+def save_state(state: SimState, path: str) -> None:
+    """Atomically write the full device state to `path` (.npz)."""
+    arrays = {name: np.asarray(getattr(state, name)) for name in SimState._fields}
+    arrays["__version__"] = np.asarray(_FORMAT_VERSION)
+    dir_ = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_state(path: str) -> SimState:
+    """Load a state written by save_state; arrays land on the default
+    device."""
+    with np.load(path) as data:
+        version = int(data["__version__"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {version}")
+        fields = {}
+        for name in SimState._fields:
+            arr = data[name]
+            fields[name] = jnp.asarray(arr)
+    return SimState(**fields)
+
+
+def hard_states(state: SimState) -> Dict[str, np.ndarray]:
+    """The durable per-peer raft state {term, vote, commit} (reference:
+    proto/proto/eraftpb.proto:94-98), shaped [P, G]."""
+    return {
+        "term": np.asarray(state.term),
+        "vote": np.asarray(state.vote),
+        "commit": np.asarray(state.commit),
+    }
