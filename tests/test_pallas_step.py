@@ -1,0 +1,115 @@
+"""The fused Pallas steady round must be bit-identical to the general XLA
+step whenever the steady predicate holds, and the fast_step dispatcher must
+match sim.step on full schedules including elections and crashes.
+
+Runs in interpret mode on CPU (the TPU compile path is exercised by
+bench.py when RAFT_TPU_PALLAS=1)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.multiraft import ClusterSim, SimConfig
+from raft_tpu.multiraft import pallas_step, sim
+
+
+@pytest.fixture(autouse=True)
+def _interpret_pallas(monkeypatch):
+    # CPU test environment: run pallas in interpreter mode.
+    from jax.experimental import pallas as pl
+
+    orig = pl.pallas_call
+
+    def patched(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(pl, "pallas_call", patched)
+    yield
+
+
+def settle(cfg, rounds=30):
+    s = ClusterSim(cfg)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    s.run(rounds, None, append)
+    return s.state
+
+
+def test_steady_round_matches_xla():
+    cfg = SimConfig(n_groups=32, n_peers=5)
+    st = settle(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed))
+
+    fast = pallas_step.steady_round(cfg)
+    for r in range(3):
+        want = sim.step(cfg, st, crashed, append)
+        got = fast(st, crashed, append)
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want, f)),
+                np.asarray(getattr(got, f)),
+                err_msg=f"round {r} field {f}",
+            )
+        st = want
+
+
+def test_steady_round_with_crashed_follower():
+    cfg = SimConfig(n_groups=16, n_peers=5)
+    st = settle(cfg)
+    crashed = np.zeros((cfg.n_peers, cfg.n_groups), bool)
+    # crash one non-leader peer per group
+    leaders = np.asarray(st.state).argmax(axis=0)
+    for g in range(cfg.n_groups):
+        crashed[(leaders[g] + 1) % cfg.n_peers, g] = True
+    crashed = jnp.asarray(crashed)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed))
+    fast = pallas_step.steady_round(cfg)
+    want = sim.step(cfg, st, crashed, append)
+    got = fast(st, crashed, append)
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)), np.asarray(getattr(got, f)), err_msg=f
+        )
+
+
+def test_predicate_rejects_non_steady():
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    fresh = sim.init_state(cfg)  # nobody elected yet
+    crashed = jnp.zeros((3, 8), bool)
+    assert not bool(pallas_step.steady_predicate(cfg, fresh, crashed))
+
+    st = settle(cfg)
+    # crash every leader: not steady
+    leaders = np.asarray(st.state) == 2
+    assert not bool(
+        pallas_step.steady_predicate(cfg, st, jnp.asarray(leaders))
+    )
+
+
+def test_fast_step_full_schedule_parity():
+    """fast_step == sim.step across elections, crashes, recovery."""
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    fast = pallas_step.fast_step(cfg)
+    a = sim.init_state(cfg)
+    b = sim.init_state(cfg)
+    rng = np.random.RandomState(5)
+    crashed = np.zeros((3, 8), bool)
+    for r in range(60):
+        if rng.rand() < 0.05:
+            crashed[rng.randint(3), rng.randint(8)] ^= True
+        c = jnp.asarray(crashed)
+        append = jnp.asarray(rng.randint(0, 2, size=8).astype(np.int32))
+        a = sim.step(cfg, a, c, append)
+        b = fast(b, c, append)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f"round {r} field {f}",
+            )
