@@ -26,14 +26,14 @@ import numpy as np
 
 G = 100_000
 P = 5
-ROUNDS_PER_SCAN = 50
-SCANS = 4
+ROUNDS_PER_SCAN = 64
+SCANS = 6
 ANCHOR_GROUPS = 4096
 ANCHOR_ROUNDS = 60
 
 
 def bench_device() -> float:
-    from raft_tpu.multiraft import sim
+    from raft_tpu.multiraft import pallas_step, sim
     from raft_tpu.multiraft.sim import SimConfig
 
     cfg = SimConfig(n_groups=G, n_peers=P)
@@ -41,17 +41,25 @@ def bench_device() -> float:
     crashed = jnp.zeros((P, G), bool)
     append = jnp.ones((G,), jnp.int32)
 
-    step = functools.partial(sim.step, cfg)
+    # Every protocol round executes fully; the fused pallas kernel runs K
+    # rounds per VMEM residency when the steady invariant provably holds,
+    # with a lax.cond fallback to the general XLA step (bit-identical
+    # semantics; see raft_tpu/multiraft/pallas_step.py).
+    K = 32
+    kstep = pallas_step.fast_multi_round(cfg, k=K)
+    full = jax.jit(functools.partial(sim.step, cfg))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def multi_round(st):
         def body(s, _):
-            return step(s, crashed, append), ()
+            return kstep(s, crashed, append), ()
 
-        st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN)
+        st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN // K)
         return st
 
-    # Warm up: compile + let elections settle into steady state.
+    # Warm up: compile + let the election storm settle into steady state.
+    for _ in range(30):
+        state = full(state, crashed, append)
     state = multi_round(state)
     jax.block_until_ready(state)
 
@@ -61,7 +69,8 @@ def bench_device() -> float:
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
 
-    ticks = G * ROUNDS_PER_SCAN * SCANS
+    rounds = (ROUNDS_PER_SCAN // K) * K * SCANS
+    ticks = G * rounds
     # Sanity: the protocol is actually running (leaders + commits advance).
     commit_min = int(jnp.min(jnp.max(state.commit, axis=0)))
     assert commit_min > 0, "bench sanity: no commits on device"

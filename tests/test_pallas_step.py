@@ -92,6 +92,48 @@ def test_predicate_rejects_non_steady():
     )
 
 
+def test_multi_round_kernel_matches_k_steps():
+    """k fused rounds == k sequential general steps from a steady state."""
+    cfg = SimConfig(n_groups=16, n_peers=3)
+    k = 4
+    st = settle(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed, horizon=k))
+
+    fused = pallas_step.steady_round(cfg, rounds=k)
+    want = st
+    for _ in range(k):
+        want = sim.step(cfg, want, crashed, append)
+    got = fused(st, crashed, append)
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)), np.asarray(getattr(got, f)), err_msg=f
+        )
+
+
+def test_fast_multi_round_full_schedule_parity():
+    """fast_multi_round == k sequential sim.steps, including rounds where
+    the predicate rejects (elections in progress)."""
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    k = 4
+    fast = pallas_step.fast_multi_round(cfg, k=k)
+    a = sim.init_state(cfg)
+    b = sim.init_state(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    for blk in range(10):  # 40 rounds: covers the initial election storm
+        for _ in range(k):
+            a = sim.step(cfg, a, crashed, append)
+        b = fast(b, crashed, append)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f"block {blk} field {f}",
+            )
+
+
 def test_fast_step_full_schedule_parity():
     """fast_step == sim.step across elections, crashes, recovery."""
     cfg = SimConfig(n_groups=8, n_peers=3)
