@@ -1,0 +1,240 @@
+"""Commit-index fast-forward via vote messages + conf-change campaign gating
+(ported behaviors from reference: test_raft.rs:4441-4800)."""
+
+import pytest
+
+from raft_tpu import (
+    ConfChange,
+    ConfChangeSingle,
+    ConfChangeType,
+    ConfChangeV2,
+    Entry,
+    EntryType,
+    MemStorage,
+    MessageType,
+    StateRole,
+)
+from raft_tpu.eraftpb import encode_conf_change, encode_conf_change_v2
+from raft_tpu.harness import Network
+
+from test_util import (
+    new_entry,
+    new_message,
+    new_message_with_entries,
+    new_test_config,
+    new_test_raft_with_config,
+)
+
+
+def remove_node(id):
+    return ConfChange(change_type=ConfChangeType.RemoveNode, node_id=id)
+
+
+def cc_entry(cc):
+    if isinstance(cc, ConfChange):
+        return Entry(
+            entry_type=EntryType.EntryConfChange, data=encode_conf_change(cc)
+        )
+    return Entry(
+        entry_type=EntryType.EntryConfChangeV2, data=encode_conf_change_v2(cc)
+    )
+
+
+def test_conf_change_check_before_campaign():
+    """A follower with an applied-lagging committed conf change refuses to
+    campaign (reference: test_raft.rs:4441-4507)."""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    m = new_message(1, 1, MessageType.MsgPropose)
+    m.entries = [cc_entry(remove_node(3))]
+    nt.send([m])
+
+    # node 2 times out: still follower, pending conf change unapplied
+    nt.peers[2].raft.reset_randomized_election_timeout()
+    timeout = nt.peers[2].raft.randomized_election_timeout
+    for _ in range(timeout):
+        nt.peers[2].raft.tick()
+    assert nt.peers[2].raft.state == StateRole.Follower
+
+    # leadership transfer to 2 also refuses (TimeoutNow -> hup blocked)
+    nt.send([new_message(2, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[2].raft.state == StateRole.Follower
+    nt.peers[1].raft.abort_leader_transfer()
+
+    committed = nt.peers[2].raft_log.committed
+    nt.peers[2].raft.commit_apply(committed)
+    nt.peers[2].raft.apply_conf_change(remove_node(3).as_v2())
+
+    # now the transfer succeeds
+    nt.send([new_message(2, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[2].raft.state == StateRole.Leader
+
+    nt.peers[1].raft.commit_apply(committed)
+    nt.peers[1].raft.apply_conf_change(remove_node(3).as_v2())
+
+    # node 1 can campaign again
+    nt.peers[1].raft.reset_randomized_election_timeout()
+    timeout = nt.peers[1].raft.randomized_election_timeout
+    for _ in range(timeout):
+        nt.peers[1].raft.tick()
+    assert nt.peers[1].raft.state == StateRole.Candidate
+
+
+def new_test_learner_raft_with_prevote(id, peers, learners, pre_vote):
+    storage = MemStorage()
+    storage.initialize_with_conf_state((peers, learners))
+    cfg = new_test_config(id, 10, 1)
+    cfg.pre_vote = pre_vote
+    return new_test_raft_with_config(cfg, storage)
+
+
+@pytest.mark.parametrize("use_prevote", [False, True])
+def test_advance_commit_index_by_vote_request(use_prevote):
+    """A (pre-)vote request's commit/commit_term can fast-forward the
+    receiver's commit index, unblocking conf changes
+    (reference: test_raft.rs:4509-4644)."""
+    cases = [
+        ConfChange(change_type=ConfChangeType.AddNode, node_id=4),
+        ConfChangeV2(
+            changes=[
+                ConfChangeSingle(ConfChangeType.AddLearnerNode, 3),
+                ConfChangeSingle(ConfChangeType.AddNode, 4),
+            ]
+        ),
+    ]
+    for i, cc in enumerate(cases):
+        peers = [
+            new_test_learner_raft_with_prevote(id, [1, 2, 3], [4], use_prevote)
+            for id in range(1, 5)
+        ]
+        nt = Network.new(peers)
+        nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+        # propose the conf change but keep it uncommitted
+        nt.ignore(MessageType.MsgAppendResponse)
+        nt.send([
+            new_message_with_entries(
+                1, 1, MessageType.MsgPropose, [cc_entry(cc)]
+            )
+        ])
+        cc_index = nt.peers[1].raft_log.last_index()
+
+        # give node 4 (learner) a longer log than voters 2/3
+        nt.recover()
+        nt.cut(1, 2)
+        nt.cut(1, 3)
+        nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+
+        # commit the conf change without node 4 hearing about it
+        nt.recover()
+        nt.cut(1, 4)
+        nt.ignore(MessageType.MsgAppend)
+        msg = new_message(2, 1, MessageType.MsgAppendResponse)
+        msg.index = nt.peers[2].raft_log.last_index()
+        nt.send([msg, new_message(1, 1, MessageType.MsgBeat)])
+
+        # leader goes down
+        nt.recover()
+        nt.isolate(1)
+
+        p4 = nt.peers[4]
+        assert p4.raft_log.committed < cc_index, f"#{i}"
+        # node 4 thinks itself a learner: won't campaign
+        for _ in range(p4.raft.randomized_election_timeout):
+            p4.raft.tick()
+        assert p4.raft.state == StateRole.Follower, f"#{i}"
+
+        p2 = nt.peers[2]
+        assert p2.raft_log.committed >= cc_index, f"#{i}"
+        p2.raft.apply_conf_change(cc.as_v2())
+        p2.raft.commit_apply(cc_index)
+
+        # node 2 campaigns; node 4 rejects (longer log) so 2 can't win...
+        for _ in range(p2.raft.randomized_election_timeout):
+            p2.raft.tick()
+        want = StateRole.PreCandidate if use_prevote else StateRole.Candidate
+        assert p2.raft.state == want, f"#{i}"
+        nt.filter_and_send(nt.read_messages())
+        assert nt.peers[2].raft.state != StateRole.Leader, f"#{i}"
+
+        # ...but 2's vote request carried the commit info: node 4 advanced
+        p4 = nt.peers[4]
+        assert p4.raft_log.committed >= cc_index, f"#{i}"
+        p4.raft.apply_conf_change(cc.as_v2())
+        p4.raft.commit_apply(cc_index)
+
+        # node 4 now knows it's a voter: it can win
+        for _ in range(p4.raft.randomized_election_timeout):
+            p4.raft.tick()
+        nt.filter_and_send(nt.read_messages())
+        assert nt.peers[4].raft.state == StateRole.Leader, f"#{i}"
+
+
+@pytest.mark.parametrize("use_prevote", [False, True])
+def test_advance_commit_index_by_vote_response(use_prevote):
+    """A rejected (pre-)vote RESPONSE also carries commit info that can
+    fast-forward the candidate (reference: test_raft.rs:4646-4800,
+    condensed to the v1 RemoveNode case)."""
+    cc = ConfChange(change_type=ConfChangeType.RemoveNode, node_id=4)
+    peers = []
+    for id in range(1, 5):
+        cfg = new_test_config(id, 10, 1)
+        cfg.pre_vote = use_prevote
+        storage = MemStorage.new_with_conf_state(([1, 2, 3, 4], []))
+        peers.append(new_test_raft_with_config(cfg, storage))
+    nt = Network.new(peers)
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    # propose the conf change but keep it uncommitted
+    nt.ignore(MessageType.MsgAppendResponse)
+    nt.send([
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [cc_entry(cc)])
+    ])
+    cc_index = nt.peers[1].raft_log.last_index()
+
+    # node 4 gets a longer log than voters 2/3
+    nt.recover()
+    nt.cut(1, 2)
+    nt.cut(1, 3)
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+
+    # a delayed ack commits the conf change (everyone connected hears)
+    msg = new_message(2, 1, MessageType.MsgAppendResponse)
+    msg.index = nt.peers[2].raft_log.last_index()
+    nt.send([msg, new_message(1, 1, MessageType.MsgBeat)])
+
+    # leader down
+    nt.recover()
+    nt.isolate(1)
+
+    p4 = nt.peers[4]
+    assert p4.raft_log.committed >= cc_index
+    p4.raft.apply_conf_change(cc.as_v2())
+    p4.raft.commit_apply(cc_index)
+    # node 4 removed itself: won't campaign
+    for _ in range(p4.raft.randomized_election_timeout):
+        p4.raft.tick()
+    assert p4.raft.state == StateRole.Follower
+
+    p2 = nt.peers[2]
+    assert p2.raft_log.committed < cc_index
+    # node 2 campaigns; node 4 rejects with commit info attached
+    for _ in range(p2.raft.randomized_election_timeout):
+        p2.raft.tick()
+    want = StateRole.PreCandidate if use_prevote else StateRole.Candidate
+    assert p2.raft.state == want
+    nt.filter_and_send(nt.read_messages())
+
+    # the rejection fast-forwarded node 2's commit; after applying it can win
+    p2 = nt.peers[2]
+    assert p2.raft_log.committed >= cc_index
+    p2.raft.apply_conf_change(cc.as_v2())
+    p2.raft.commit_apply(cc_index)
+    for _ in range(p2.raft.randomized_election_timeout):
+        p2.raft.tick()
+    nt.filter_and_send(nt.read_messages())
+    assert nt.peers[2].raft.state == StateRole.Leader

@@ -1,0 +1,228 @@
+"""ReadIndex / read-only suites (ported behaviors from reference:
+harness/tests/integration_cases/test_raft.rs:2230-2610 + 1442-1483)."""
+
+from raft_tpu import (
+    Entry,
+    HardState,
+    MemStorage,
+    MessageType,
+    ReadOnlyOption,
+    StateRole,
+)
+from raft_tpu.harness import Network
+
+from test_util import (
+    empty_entry,
+    new_entry,
+    new_message,
+    new_message_with_entries,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+)
+
+
+def test_read_only_option_lease():
+    """reference: test_raft.rs:2394-2469"""
+    a = new_test_raft(1, [1, 2, 3], 10, 1)
+    b = new_test_raft(2, [1, 2, 3], 10, 1)
+    c = new_test_raft(3, [1, 2, 3], 10, 1)
+    for x in (a, b, c):
+        x.raft.read_only.option = ReadOnlyOption.LeaseBased
+        x.raft.check_quorum = True
+    nt = Network.new([a, b, c])
+
+    b_et = nt.peers[2].raft.election_timeout
+    nt.peers[2].raft.set_randomized_election_timeout(b_et + 1)
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    tests = [
+        (1, 10, 11, b"ctx1"),
+        (2, 10, 21, b"ctx2"),
+        (3, 10, 31, b"ctx3"),
+        (1, 10, 41, b"ctx4"),
+        (2, 10, 51, b"ctx5"),
+        (3, 10, 61, b"ctx6"),
+    ]
+    for i, (id, proposals, wri, wctx) in enumerate(tests):
+        for _ in range(proposals):
+            nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+        nt.send([
+            new_message_with_entries(
+                id, id, MessageType.MsgReadIndex, [new_entry(0, 0, wctx)]
+            )
+        ])
+        read_states = nt.peers[id].raft.read_states
+        nt.peers[id].raft.read_states = []
+        assert read_states, f"#{i}"
+        assert read_states[0].index == wri, f"#{i}"
+        assert read_states[0].request_ctx == wctx, f"#{i}"
+
+
+def test_read_only_option_lease_without_check_quorum():
+    """reference: test_raft.rs:2471-2501"""
+    a = new_test_raft(1, [1, 2, 3], 10, 1)
+    b = new_test_raft(2, [1, 2, 3], 10, 1)
+    c = new_test_raft(3, [1, 2, 3], 10, 1)
+    for x in (a, b, c):
+        x.raft.read_only.option = ReadOnlyOption.LeaseBased
+    nt = Network.new([a, b, c])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    ctx = b"ctx1"
+    nt.send([
+        new_message_with_entries(
+            2, 2, MessageType.MsgReadIndex, [new_entry(0, 0, ctx)]
+        )
+    ])
+    read_states = nt.peers[2].raft.read_states
+    assert read_states
+    assert read_states[0].index == 1
+    assert read_states[0].request_ctx == ctx
+
+
+def test_read_only_for_new_leader():
+    """A new leader serves reads only after committing in its own term
+    (reference: test_raft.rs:2503-2581)."""
+    heartbeat_ticks = 1
+    node_configs = [(1, 1, 1, 0), (2, 2, 2, 2), (3, 2, 2, 2)]
+    peers = []
+    for id, committed, applied, compact_index in node_configs:
+        cfg = new_test_config(id, 10, heartbeat_ticks)
+        cfg.applied = applied
+        storage = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with storage.wl() as core:
+            core.append([empty_entry(1, 1), empty_entry(1, 2)])
+            core.set_hardstate(HardState(term=1, commit=committed))
+            if compact_index:
+                core.compact(compact_index)
+        peers.append(new_test_raft_with_config(cfg, storage))
+    nt = Network.new(peers)
+
+    # Forbid peer 1 from committing in its term.
+    nt.ignore(MessageType.MsgAppend)
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    wctx = b"ctx"
+    nt.send([
+        new_message_with_entries(
+            1, 1, MessageType.MsgReadIndex, [new_entry(0, 0, wctx)]
+        )
+    ])
+    assert nt.peers[1].raft.read_states == []
+
+    nt.recover()
+    for _ in range(heartbeat_ticks):
+        nt.peers[1].raft.tick()
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    assert nt.peers[1].raft_log.committed == 4
+    assert (
+        nt.peers[1].raft_log.term_or(nt.peers[1].raft_log.committed)
+        == nt.peers[1].raft.term
+    )
+
+    nt.send([
+        new_message_with_entries(
+            1, 1, MessageType.MsgReadIndex, [new_entry(0, 0, wctx)]
+        )
+    ])
+    read_states = nt.peers[1].raft.read_states
+    assert len(read_states) == 1
+    assert read_states[0].index == 4
+    assert read_states[0].request_ctx == wctx
+
+
+def test_advance_commit_index_by_read_index_response():
+    """reference: test_raft.rs:2583-2609"""
+    tt = Network.new([None, None, None, None, None])
+    tt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    # don't commit entries
+    tt.cut(1, 3)
+    tt.cut(1, 4)
+    tt.cut(1, 5)
+    tt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    tt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+
+    tt.recover()
+    tt.cut(1, 2)
+
+    # commit entries for the leader but not node 2
+    tt.send([new_message(3, 1, MessageType.MsgReadIndex, 1)])
+    assert tt.peers[1].raft_log.committed == 3
+    assert tt.peers[2].raft_log.committed == 1
+
+    tt.recover()
+    # LeaseBased: no heartbeat quorum round advances node 2's commit —
+    # only the MsgReadIndexResp does.
+    tt.peers[1].raft.read_only.option = ReadOnlyOption.LeaseBased
+    tt.send([new_message(2, 1, MessageType.MsgReadIndex, 1)])
+    assert tt.peers[2].raft_log.committed == 3
+
+
+def test_raft_frees_read_only_mem():
+    """reference: test_raft.rs:1442-1483"""
+    sm = new_test_raft(1, [1, 2], 5, 1)
+    sm.raft.become_candidate()
+    sm.raft.become_leader()
+    sm.persist()
+    # commit an entry in this term so reads are served
+    sm.raft_log.commit_to(sm.raft_log.last_index())
+
+    ctx = b"ctx"
+    # leader starts linearizable read request: ctx attaches to heartbeats
+    m = new_message_with_entries(2, 1, MessageType.MsgReadIndex, [new_entry(0, 0, ctx)])
+    sm.step(m)
+    msgs = sm.read_messages()
+    assert len(msgs) == 1
+    assert msgs[0].msg_type == MessageType.MsgHeartbeat
+    assert msgs[0].context == ctx
+    assert len(sm.raft.read_only.read_index_queue) == 1
+    assert len(sm.raft.read_only.pending_read_index) == 1
+
+    # heartbeat ack clears the pending read
+    hr = new_message(2, 1, MessageType.MsgHeartbeatResponse)
+    hr.context = ctx
+    sm.step(hr)
+    assert len(sm.raft.read_only.read_index_queue) == 0
+    assert len(sm.raft.read_only.pending_read_index) == 0
+
+
+def test_read_only_with_learner():
+    """reference: test_raft.rs:2321-2392 (condensed: reads work with a
+    learner in the cluster)."""
+    storage1 = MemStorage()
+    storage1.initialize_with_conf_state(([1], [2]))
+    cfg1 = new_test_config(1, 10, 1)
+    a = new_test_raft_with_config(cfg1, storage1)
+    storage2 = MemStorage()
+    storage2.initialize_with_conf_state(([1], [2]))
+    cfg2 = new_test_config(2, 10, 1)
+    b = new_test_raft_with_config(cfg2, storage2)
+    nt = Network.new([a, b])
+    timeout = nt.peers[1].raft.randomized_election_timeout
+    for _ in range(timeout):
+        nt.peers[1].raft.tick()
+    nt.peers[1].persist()
+    nt.send(nt.filter(nt.peers[1].read_messages()))
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    for i, (id, proposals, wri, wctx) in enumerate(
+        [(1, 10, 11, b"ctx1"), (2, 10, 21, b"ctx2")]
+    ):
+        for _ in range(proposals):
+            nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+        nt.send([
+            new_message_with_entries(
+                id, id, MessageType.MsgReadIndex, [new_entry(0, 0, wctx)]
+            )
+        ])
+        rs = nt.peers[id].raft.read_states
+        nt.peers[id].raft.read_states = []
+        assert rs, f"#{i}"
+        assert rs[0].index == wri, f"#{i}"
+        assert rs[0].request_ctx == wctx, f"#{i}"
