@@ -1,0 +1,206 @@
+"""Leader-side Progress behaviors: self-tracking, pause/resume, flow
+control, commit math (ported behaviors from reference:
+harness/tests/integration_cases/test_raft.rs:302-437, 1145-1242)."""
+
+from raft_tpu import (
+    ConfChange,
+    ConfChangeType,
+    Entry,
+    HardState,
+    MemStorage,
+    MessageType,
+    ProgressState,
+)
+from raft_tpu.quorum import U64_MAX
+
+from test_util import (
+    empty_entry,
+    new_entry,
+    new_message,
+    new_message_with_entries,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+)
+
+
+def add_node(id):
+    return ConfChange(change_type=ConfChangeType.AddNode, node_id=id).as_v2()
+
+
+def test_progress_committed_index():
+    """Acked commits flow into each peer's Progress.committed_index
+    (reference: test_raft.rs:116-300, condensed)."""
+    from raft_tpu.harness import Network
+
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    # #1: 35 (noop commits at 1)
+    prs = nt.peers[1].raft.prs
+    assert [prs.get(i).committed_index for i in (1, 2, 3)] == [1, 1, 1]
+
+    nt.send([new_message_with_entries(1, 1, MessageType.MsgPropose, [new_entry(0, 0, b"some data")])])
+    prs = nt.peers[1].raft.prs
+    assert [prs.get(i).committed_index for i in (1, 2, 3)] == [2, 2, 2]
+
+
+def test_progress_leader():
+    """The leader's own progress advances as it persists
+    (reference: test_raft.rs:302-326)."""
+    raft = new_test_raft(1, [1, 2], 5, 1)
+    raft.raft.become_candidate()
+    raft.raft.become_leader()
+    raft.persist()
+    raft.raft.prs.get_mut(2).become_replicate()
+
+    for i in range(5):
+        pr1 = raft.raft.prs.get(1)
+        assert pr1.state == ProgressState.Replicate
+        assert pr1.matched == i + 1
+        assert pr1.next_idx == pr1.matched + 1
+        raft.step(new_message(1, 1, MessageType.MsgPropose, 1))
+        raft.persist()
+
+
+def test_progress_resume_by_heartbeat_resp():
+    """reference: test_raft.rs:331-347"""
+    raft = new_test_raft(1, [1, 2], 5, 1)
+    raft.raft.become_candidate()
+    raft.raft.become_leader()
+    raft.raft.prs.get_mut(2).paused = True
+
+    raft.step(new_message(1, 1, MessageType.MsgBeat))
+    assert raft.raft.prs.get(2).paused
+
+    raft.raft.prs.get_mut(2).become_replicate()
+    raft.step(new_message(2, 1, MessageType.MsgHeartbeatResponse))
+    assert not raft.raft.prs.get(2).paused
+
+
+def test_progress_paused():
+    """Probe state sends at most one append per interval
+    (reference: test_raft.rs:349-367)."""
+    raft = new_test_raft(1, [1, 2], 5, 1)
+    raft.raft.become_candidate()
+    raft.raft.become_leader()
+    m = new_message_with_entries(1, 1, MessageType.MsgPropose, [new_entry(0, 0, b"some_data")])
+    raft.step(m)
+    raft.step(new_message_with_entries(1, 1, MessageType.MsgPropose, [new_entry(0, 0, b"some_data")]))
+    raft.step(new_message_with_entries(1, 1, MessageType.MsgPropose, [new_entry(0, 0, b"some_data")]))
+    assert len(raft.read_messages()) == 1
+
+
+def test_progress_flow_control():
+    """max_inflight_msgs + max_size_per_msg shape the append stream
+    (reference: test_raft.rs:369-437)."""
+    cfg = new_test_config(1, 5, 1)
+    cfg.max_inflight_msgs = 3
+    cfg.max_size_per_msg = 2048
+    s = MemStorage.new_with_conf_state(([1, 2], []))
+    r = new_test_raft_with_config(cfg, s)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    r.read_messages()
+
+    r.raft.prs.get_mut(2).become_probe()
+    data = b"a" * 1000
+    for _ in range(10):
+        r.step(
+            new_message_with_entries(
+                1, 1, MessageType.MsgPropose, [new_entry(0, 0, data)]
+            )
+        )
+
+    # probe state: one append with the noop + first proposal
+    ms = r.read_messages()
+    assert len(ms) == 1
+    assert ms[0].msg_type == MessageType.MsgAppend
+    assert len(ms[0].entries) == 2
+    assert len(ms[0].entries[0].data) == 0
+    assert len(ms[0].entries[1].data) == 1000
+
+    # ack -> replicate: window of 3, size-capped to 2 entries each
+    msg = new_message(2, 1, MessageType.MsgAppendResponse)
+    msg.index = ms[0].entries[1].index
+    r.step(msg)
+    ms = r.read_messages()
+    assert len(ms) == 3
+    for i, m in enumerate(ms):
+        assert m.msg_type == MessageType.MsgAppend, f"#{i}"
+        assert len(m.entries) == 2, f"#{i}"
+
+    # ack all three: the remaining three entries come in two appends
+    msg = new_message(2, 1, MessageType.MsgAppendResponse)
+    msg.index = ms[2].entries[1].index
+    r.step(msg)
+    ms = r.read_messages()
+    assert len(ms) == 2
+    assert len(ms[0].entries) == 2
+    assert len(ms[1].entries) == 1
+
+
+def test_commit():
+    """maybe_commit across cluster shapes and term gating
+    (reference: test_raft.rs:1145-1242)."""
+    tests = [
+        # (matches, logs, sm_term, w_commit)
+        ([1], [empty_entry(1, 1)], 1, 1),
+        ([1], [empty_entry(1, 1)], 2, 0),
+        ([2], [empty_entry(1, 1), empty_entry(2, 2)], 2, 2),
+        ([1], [empty_entry(2, 1)], 2, 1),
+        ([2, 1, 1], [empty_entry(1, 1), empty_entry(2, 2)], 1, 1),
+        ([2, 1, 1], [empty_entry(1, 1), empty_entry(1, 2)], 2, 0),
+        ([2, 1, 2], [empty_entry(1, 1), empty_entry(2, 2)], 2, 2),
+        ([2, 1, 2], [empty_entry(1, 1), empty_entry(1, 2)], 2, 0),
+        ([2, 1, 1, 1], [empty_entry(1, 1), empty_entry(2, 2)], 1, 1),
+        ([2, 1, 1, 1], [empty_entry(1, 1), empty_entry(1, 2)], 2, 0),
+        ([2, 1, 1, 2], [empty_entry(1, 1), empty_entry(2, 2)], 1, 1),
+        ([2, 1, 1, 2], [empty_entry(1, 1), empty_entry(1, 2)], 2, 0),
+        ([2, 1, 2, 2], [empty_entry(1, 1), empty_entry(2, 2)], 2, 2),
+        ([2, 1, 2, 2], [empty_entry(1, 1), empty_entry(1, 2)], 2, 0),
+    ]
+    for i, (matches, logs, sm_term, w) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1], []))
+        with store.wl() as core:
+            core.append(logs)
+            core.set_hardstate(HardState(term=sm_term))
+        cfg = new_test_config(1, 5, 1)
+        sm = new_test_raft_with_config(cfg, store)
+
+        for j, v in enumerate(matches):
+            id = j + 1
+            if sm.raft.prs.get(id) is None:
+                sm.raft.apply_conf_change(add_node(id))
+                pr = sm.raft.prs.get_mut(id)
+                pr.matched = v
+                pr.next_idx = v + 1
+        sm.raft.maybe_commit()
+        assert sm.raft_log.committed == w, f"#{i}"
+
+
+def test_pass_election_timeout():
+    """The deterministic draw spreads over [et, 2et) like the reference's
+    uniform RNG (reference: test_raft.rs:1243-1279, adapted: our draw is a
+    counter hash keyed by term, so we sweep terms instead of re-rolling)."""
+    tests = [
+        (5, 0.0, False),
+        (10, 0.1, True),
+        (13, 0.4, True),
+        (15, 0.6, True),
+        (18, 0.9, True),
+        (20, 1.0, False),
+    ]
+    for i, (elapse, wprob, round_) in enumerate(tests):
+        sm = new_test_raft(1, [1], 10, 1)
+        sm.raft.election_elapsed = elapse
+        c = 0
+        n = 5000
+        for t in range(n):
+            sm.raft.term = t  # vary the draw key
+            sm.raft.reset_randomized_election_timeout()
+            if sm.raft.pass_election_timeout():
+                c += 1
+        got = c / n
+        if round_:
+            got = int(got * 10 + 0.5) / 10
+        assert abs(got - wprob) < 1e-6, f"#{i}: {got} vs {wprob}"

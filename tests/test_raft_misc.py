@@ -1,0 +1,313 @@
+"""Remaining core behaviors: append-response wait reset, vote request
+semantics, state transitions, disruptive followers, bcast_beat, send_append
+per progress state (ported behaviors from reference:
+harness/tests/integration_cases/test_raft.rs)."""
+
+import pytest
+
+from raft_tpu import (
+    MemStorage,
+    MessageType,
+    ProgressState,
+    StateRole,
+    vote_resp_msg_type,
+)
+from raft_tpu.harness import Network
+
+from test_util import (
+    empty_entry,
+    new_message,
+    new_snapshot,
+    new_storage,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+    new_test_raft_with_prevote,
+)
+
+
+def test_msg_append_response_wait_reset():
+    """reference: test_raft.rs:1484-1530"""
+    sm = new_test_raft(1, [1, 2, 3], 5, 1)
+    sm.raft.become_candidate()
+    sm.raft.become_leader()
+    sm.persist()
+    sm.raft.bcast_append()
+    sm.read_messages()
+
+    # Node 2 acks the first entry, committing it.
+    m = new_message(2, 0, MessageType.MsgAppendResponse)
+    m.index = 1
+    sm.step(m)
+    assert sm.raft_log.committed == 1
+    sm.read_messages()
+
+    # A new proposal broadcasts only to the non-waiting node 2.
+    m = new_message(1, 0, MessageType.MsgPropose)
+    m.entries = [empty_entry(0, 0)]
+    sm.step(m)
+    sm.persist()
+    msgs = sm.read_messages()
+    assert len(msgs) == 1
+    assert msgs[0].msg_type == MessageType.MsgAppend
+    assert msgs[0].to == 2
+    assert len(msgs[0].entries) == 1
+    assert msgs[0].entries[0].index == 2
+
+    # Node 3's ack releases its wait: entry 2 flows to it.
+    m = new_message(3, 0, MessageType.MsgAppendResponse)
+    m.index = 1
+    sm.step(m)
+    msgs = sm.read_messages()
+    assert len(msgs) == 1
+    assert msgs[0].msg_type == MessageType.MsgAppend
+    assert msgs[0].to == 3
+    assert len(msgs[0].entries) == 1
+    assert msgs[0].entries[0].index == 2
+
+
+@pytest.mark.parametrize(
+    "msg_type", [MessageType.MsgRequestVote, MessageType.MsgRequestPreVote]
+)
+def test_recv_msg_request_vote(msg_type):
+    """reference: test_raft.rs:1532-1606"""
+    tests = [
+        (StateRole.Follower, 0, 0, 0, True),
+        (StateRole.Follower, 0, 1, 0, True),
+        (StateRole.Follower, 0, 2, 0, True),
+        (StateRole.Follower, 0, 3, 0, False),
+        (StateRole.Follower, 1, 0, 0, True),
+        (StateRole.Follower, 1, 1, 0, True),
+        (StateRole.Follower, 1, 2, 0, True),
+        (StateRole.Follower, 1, 3, 0, False),
+        (StateRole.Follower, 2, 0, 0, True),
+        (StateRole.Follower, 2, 1, 0, True),
+        (StateRole.Follower, 2, 2, 0, False),
+        (StateRole.Follower, 2, 3, 0, False),
+        (StateRole.Follower, 3, 0, 0, True),
+        (StateRole.Follower, 3, 1, 0, True),
+        (StateRole.Follower, 3, 2, 0, False),
+        (StateRole.Follower, 3, 3, 0, False),
+        (StateRole.Follower, 3, 2, 2, False),
+        (StateRole.Follower, 3, 2, 1, True),
+        (StateRole.Leader, 3, 3, 1, True),
+        (StateRole.PreCandidate, 3, 3, 1, True),
+        (StateRole.Candidate, 3, 3, 1, True),
+    ]
+    for j, (state, index, log_term, vote_for, w_reject) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1], []))
+        with store.wl() as core:
+            core.append([empty_entry(2, 1), empty_entry(2, 2)])
+        sm = new_test_raft(1, [1], 10, 1, store)
+        sm.raft.state = state
+        sm.raft.vote = vote_for
+
+        m = new_message(2, 0, msg_type)
+        m.index = index
+        m.log_term = log_term
+        term = max(sm.raft_log.last_term(), log_term)
+        m.term = term
+        sm.raft.term = term
+        sm.step(m)
+
+        msgs = sm.read_messages()
+        assert len(msgs) == 1, f"#{j}"
+        assert msgs[0].msg_type == vote_resp_msg_type(msg_type), f"#{j}"
+        assert msgs[0].reject == w_reject, f"#{j}"
+
+
+def test_state_transition():
+    """reference: test_raft.rs:1608-1719"""
+    tests = [
+        (StateRole.Follower, StateRole.Follower, True, 1, 0),
+        (StateRole.Follower, StateRole.PreCandidate, True, 0, 0),
+        (StateRole.Follower, StateRole.Candidate, True, 1, 0),
+        (StateRole.Follower, StateRole.Leader, False, 0, 0),
+        (StateRole.PreCandidate, StateRole.Follower, True, 0, 0),
+        (StateRole.PreCandidate, StateRole.PreCandidate, True, 0, 0),
+        (StateRole.PreCandidate, StateRole.Candidate, True, 1, 0),
+        (StateRole.PreCandidate, StateRole.Leader, True, 0, 1),
+        (StateRole.Candidate, StateRole.Follower, True, 0, 0),
+        (StateRole.Candidate, StateRole.PreCandidate, True, 0, 0),
+        (StateRole.Candidate, StateRole.Candidate, True, 1, 0),
+        (StateRole.Candidate, StateRole.Leader, True, 0, 1),
+        (StateRole.Leader, StateRole.Follower, True, 1, 0),
+        (StateRole.Leader, StateRole.PreCandidate, False, 0, 0),
+        (StateRole.Leader, StateRole.Candidate, False, 1, 0),
+        (StateRole.Leader, StateRole.Leader, True, 0, 1),
+    ]
+    for i, (from_, to, wallow, wterm, wlead) in enumerate(tests):
+        sm = new_test_raft(1, [1], 10, 1)
+        sm.raft.state = from_
+
+        failed = False
+        try:
+            if to == StateRole.Follower:
+                sm.raft.become_follower(wterm, wlead)
+            elif to == StateRole.PreCandidate:
+                sm.raft.become_pre_candidate()
+            elif to == StateRole.Candidate:
+                sm.raft.become_candidate()
+            else:
+                sm.raft.become_leader()
+        except AssertionError:
+            failed = True
+
+        assert failed == (not wallow), f"#{i}"
+        if wallow:
+            assert sm.raft.term == wterm, f"#{i}"
+            assert sm.raft.leader_id == wlead, f"#{i}"
+
+
+def test_disruptive_follower():
+    """A check-quorum cluster heals a partitioned follower's disruption via
+    the higher-term MsgAppendResponse nudge (reference:
+    test_raft.rs:2088-2177)."""
+    n1 = new_test_raft(1, [1, 2, 3], 10, 1)
+    n2 = new_test_raft(2, [1, 2, 3], 10, 1)
+    n3 = new_test_raft(3, [1, 2, 3], 10, 1)
+    for n in (n1, n2, n3):
+        n.raft.check_quorum = True
+    nt = Network.new([n1, n2, n3])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[2].raft.state == StateRole.Follower
+    assert nt.peers[3].raft.state == StateRole.Follower
+
+    # etcd-style: follower 3 times out (its timer wasn't refreshed because
+    # we stop delivering) and becomes candidate at term 3.
+    nt.isolate(3)
+    p3 = nt.peers[3]
+    for _ in range(p3.raft.randomized_election_timeout):
+        p3.raft.tick()
+    p3.read_messages()
+    assert p3.raft.state == StateRole.Candidate
+    assert p3.raft.term == 2
+
+    nt.recover()
+    # leader 1 sends a heartbeat to 3 (lower term): with check_quorum the
+    # candidate replies MsgAppendResponse at its higher term, deposing 1.
+    m = new_message(1, 3, MessageType.MsgHeartbeat)
+    m.term = nt.peers[1].raft.term
+    nt.send([m])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[1].raft.term == nt.peers[3].raft.term
+
+
+def test_disruptive_follower_pre_vote():
+    """Pre-vote prevents term inflation entirely
+    (reference: test_raft.rs:2179-2228)."""
+    n1 = new_test_raft_with_prevote(1, [1, 2, 3], 10, 1)
+    n2 = new_test_raft_with_prevote(2, [1, 2, 3], 10, 1)
+    n3 = new_test_raft_with_prevote(3, [1, 2, 3], 10, 1)
+    for n in (n1, n2, n3):
+        n.raft.check_quorum = True
+    nt = Network.new([n1, n2, n3])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    nt.isolate(3)
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    p3 = nt.peers[3]
+    for _ in range(p3.raft.randomized_election_timeout):
+        p3.raft.tick()
+    p3.read_messages()
+    assert p3.raft.state == StateRole.PreCandidate
+    assert p3.raft.term == 1  # pre-vote: no term bump
+
+    nt.recover()
+    # the leader isn't disrupted
+    nt.send([new_message(1, 3, MessageType.MsgBeat)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+
+def test_bcast_beat():
+    """Heartbeats never carry entries, and carry clamped commit indexes
+    (reference: test_raft.rs:2680-2754)."""
+    offset = 1000
+    s = new_snapshot(offset, 1, [1, 2, 3])
+    store = new_storage()
+    with store.wl() as core:
+        core.apply_snapshot(s)
+    sm = new_test_raft(1, [1, 2, 3], 10, 1, store)
+    sm.raft.term = 1
+
+    sm.raft.become_candidate()
+    sm.raft.become_leader()
+    for i in range(10):
+        assert sm.raft.append_entry([empty_entry(0, offset + i + 1)])
+    sm.persist()
+
+    # slow node 2 / fast node 3
+    sm.raft.prs.get_mut(2).matched = 5
+    sm.raft.prs.get_mut(2).next_idx = 6
+    sm.raft.prs.get_mut(3).matched = sm.raft_log.last_index()
+    sm.raft.prs.get_mut(3).next_idx = sm.raft_log.last_index() + 1
+
+    sm.step(new_message(1, 1, MessageType.MsgBeat))
+    msgs = sorted(sm.read_messages(), key=lambda m: m.to)
+    assert len(msgs) == 2
+    want_commits = {
+        2: min(sm.raft_log.committed, 5),
+        3: min(sm.raft_log.committed, sm.raft_log.last_index()),
+    }
+    for m in msgs:
+        assert m.msg_type == MessageType.MsgHeartbeat
+        assert m.index == 0
+        assert m.log_term == 0
+        assert m.commit == want_commits[m.to]
+        assert not m.entries
+
+
+def test_send_append_for_progress_probe():
+    """reference: test_raft.rs:2830-2879"""
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    r.read_messages()
+    r.raft.prs.get_mut(2).become_probe()
+
+    # each of the first sends goes out, then the probe pauses
+    for i in range(3):
+        if i == 0:
+            # we send only one append in probe state
+            assert r.raft.append_entry([empty_entry(0, 0)])
+            r.raft.send_append(2)
+            msgs = r.read_messages()
+            assert len(msgs) == 1
+            assert r.raft.prs.get(2).paused
+        else:
+            assert r.raft.append_entry([empty_entry(0, 0)])
+            r.raft.send_append(2)
+            assert r.read_messages() == []
+
+
+def test_send_append_for_progress_replicate():
+    """reference: test_raft.rs:2881-2895"""
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    r.read_messages()
+    r.raft.prs.get_mut(2).become_replicate()
+
+    for _ in range(10):
+        assert r.raft.append_entry([empty_entry(0, 0)])
+        r.raft.send_append(2)
+        assert len(r.read_messages()) == 1
+
+
+def test_send_append_for_progress_snapshot():
+    """reference: test_raft.rs:2897-2911"""
+    r = new_test_raft(1, [1, 2], 10, 1)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    r.read_messages()
+    r.raft.prs.get_mut(2).become_snapshot(10)
+
+    for _ in range(10):
+        assert r.raft.append_entry([empty_entry(0, 0)])
+        r.raft.send_append(2)
+        assert r.read_messages() == []
