@@ -1,0 +1,33 @@
+# tpu-multiraft build/test entry points (SURVEY.md §2 #27: the quality gate
+# is the test suite; native code builds lazily but can be forced here).
+
+PY ?= python
+
+.PHONY: all test test-fast bench bench-suites native examples clean
+
+all: native test
+
+native: cpp/libmultiraft.so
+
+cpp/libmultiraft.so: cpp/multiraft_engine.cpp
+	g++ -O3 -std=c++17 -shared -fPIC -o $@ $<
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q --ignore=tests/test_pallas_step.py
+
+bench:
+	$(PY) bench.py
+
+bench-suites:
+	$(PY) benches/suites.py
+
+examples:
+	$(PY) examples/single_mem_node.py
+	$(PY) examples/five_mem_node.py
+
+clean:
+	rm -f cpp/libmultiraft.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

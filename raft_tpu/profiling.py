@@ -1,0 +1,70 @@
+"""Profiling hooks (SURVEY.md §5.1: the reference's observability is
+structured slog logging + Criterion; our device path adds JAX profiler
+traces so kernel time is inspectable in TensorBoard/Perfetto).
+
+Usage:
+
+    from raft_tpu.profiling import device_trace, RoundTimer
+
+    with device_trace("/tmp/raft-trace"):      # xprof/perfetto trace
+        sim.run(100, crashed, append)
+
+    timer = RoundTimer()
+    with timer.round():
+        state = step(state, crashed, append)
+        jax.block_until_ready(state)
+    print(timer.summary())
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, host_profiler: bool = False):
+    """Capture a JAX profiler trace of everything inside the block; view
+    with TensorBoard's profile plugin or ui.perfetto.dev."""
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=host_profiler)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows up on the host timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class RoundTimer:
+    """Lightweight wall-clock histogram for protocol rounds — the host-side
+    equivalent of the reference's Criterion loops."""
+
+    def __init__(self) -> None:
+        self.samples: List[float] = []
+
+    @contextlib.contextmanager
+    def round(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.samples.append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.samples:
+            return {"count": 0}
+        xs = sorted(self.samples)
+        n = len(xs)
+        return {
+            "count": n,
+            "mean_ms": sum(xs) / n * 1e3,
+            "p50_ms": xs[n // 2] * 1e3,
+            "p99_ms": xs[min(n - 1, int(n * 0.99))] * 1e3,
+            "max_ms": xs[-1] * 1e3,
+        }

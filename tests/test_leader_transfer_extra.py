@@ -1,0 +1,330 @@
+"""Leader-transfer corner cases + membership/restore extras (ported
+behaviors from reference: test_raft.rs:3290-3810, 3947-4072, 4249-4286)."""
+
+import pytest
+
+from raft_tpu import (
+    ConfChange,
+    ConfChangeType,
+    ConfigInvalid,
+    Config,
+    MemStorage,
+    MessageType,
+    ProposalDropped,
+    StateRole,
+    conf_state_eq,
+    ConfState,
+)
+from raft_tpu.harness import Network
+
+from test_util import (
+    new_message,
+    new_snapshot,
+    new_storage,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+)
+
+
+def remove_node(id):
+    return ConfChange(change_type=ConfChangeType.RemoveNode, node_id=id).as_v2()
+
+
+def add_node(id):
+    return ConfChange(change_type=ConfChangeType.AddNode, node_id=id).as_v2()
+
+
+def test_leader_transfer_with_check_quorum():
+    """reference: test_raft.rs:3390-3423"""
+    nt = Network.new([None, None, None])
+    for i in (1, 2, 3):
+        nt.peers[i].raft.check_quorum = True
+        nt.peers[i].raft.set_randomized_election_timeout(
+            nt.peers[i].raft.election_timeout + i
+        )
+    # let peer 2's lease expire
+    b_et = nt.peers[2].raft.election_timeout
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    nt.send([new_message(2, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[2].raft.state == StateRole.Leader
+
+    # transfer back with check-quorum in effect
+    nt.send([new_message(1, 2, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+
+def test_leader_transfer_after_snapshot():
+    """reference: test_raft.rs:3443-3476"""
+    from test_raft import next_ents
+
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.isolate(3)
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    next_ents(nt.peers[1].raft, nt.storage[1])
+    with nt.storage[1].wl() as core:
+        core.commit_to(nt.peers[1].raft_log.applied)
+        core.compact(nt.peers[1].raft_log.applied)
+
+    nt.recover()
+    assert nt.peers[1].raft.prs.get(3).matched == 1
+
+    # Transfer leadership to 3 when it needs a snapshot first.
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    # 3 sends the MsgAppendResponse after restoring; transfer completes.
+    nt.send([new_message(3, 1, MessageType.MsgAppendResponse)])
+    assert nt.peers[3].raft.state == StateRole.Leader
+
+
+def test_leader_transfer_ignore_proposal():
+    """reference: test_raft.rs:3543-3566"""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.isolate(3)
+
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.lead_transferee == 3
+
+    with pytest.raises(ProposalDropped):
+        nt.peers[1].raft.step(new_message(1, 1, MessageType.MsgPropose, 1))
+    assert nt.peers[1].raft.prs.get(1).matched == 1
+
+
+def test_leader_transfer_remove_node():
+    """reference: test_raft.rs:3590-3612"""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.ignore(MessageType.MsgTimeoutNow)
+
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.lead_transferee == 3
+
+    # removing the transfer target aborts the transfer
+    nt.peers[1].raft.apply_conf_change(remove_node(3))
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[1].raft.lead_transferee is None
+
+
+def test_leader_transfer_second_transfer_to_another_node():
+    """reference: test_raft.rs:3633-3651"""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.isolate(3)
+
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.lead_transferee == 3
+
+    # a second transfer to another node overrides the first
+    nt.send([new_message(2, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[2].raft.state == StateRole.Leader
+
+
+def test_transfer_non_member():
+    """reference: test_raft.rs:3693-3710"""
+    r = new_test_raft(1, [2, 3, 4], 5, 1)
+    r.step(new_message(2, 1, MessageType.MsgTimeoutNow))
+    r.step(new_message(2, 1, MessageType.MsgRequestVoteResponse))
+    r.step(new_message(3, 1, MessageType.MsgRequestVoteResponse))
+    assert r.raft.state == StateRole.Follower
+
+
+def test_commit_after_remove_node():
+    """Pending entries commit once a node leaves the quorum
+    (reference: test_raft.rs:3291-3343)."""
+    from raft_tpu.eraftpb import Entry, EntryType, encode_conf_change
+    from test_raft import next_ents
+
+    store = MemStorage.new_with_conf_state(([1, 2], []))
+    r = new_test_raft_with_config(new_test_config(1, 5, 1), store)
+    r.raft.become_candidate()
+    r.raft.become_leader()
+
+    # begin removing node 2
+    cc = ConfChange(change_type=ConfChangeType.RemoveNode, node_id=2)
+    m = new_message(0, 0, MessageType.MsgPropose)
+    m.entries = [
+        Entry(entry_type=EntryType.EntryConfChange, data=encode_conf_change(cc))
+    ]
+    r.step(m)
+    # stabilize: nothing committed yet (node 2 hasn't acked)
+    assert next_ents(r.raft, store) == []
+    cc_index = r.raft_log.last_index()
+
+    # while the conf change is pending, another proposal
+    m = new_message(0, 0, MessageType.MsgPropose)
+    m.entries = [Entry(data=b"hello")]
+    r.step(m)
+
+    # node 2 acks the conf change, committing it (and the noop)
+    m = new_message(2, 0, MessageType.MsgAppendResponse)
+    m.index = cc_index
+    r.step(m)
+    ents = next_ents(r.raft, store)
+    assert len(ents) == 2
+    assert ents[0].entry_type == EntryType.EntryNormal
+    assert ents[0].data == b""
+    assert ents[1].entry_type == EntryType.EntryConfChange
+
+    # applying the conf change shrinks the quorum: "hello" commits
+    r.raft.apply_conf_change(cc.as_v2())
+    ents = next_ents(r.raft, store)
+    assert len(ents) == 1
+    assert ents[0].entry_type == EntryType.EntryNormal
+    assert ents[0].data == b"hello"
+
+
+def test_node_with_smaller_term_can_complete_election():
+    """reference: test_raft.rs:3712-3806 (condensed)"""
+    n1 = new_test_raft(1, [1, 2, 3], 10, 1)
+    n2 = new_test_raft(2, [1, 2, 3], 10, 1)
+    n3 = new_test_raft(3, [1, 2, 3], 10, 1)
+    for n in (n1, n2, n3):
+        n.raft.pre_vote = True
+    nt = Network.new([n1, n2, n3])
+
+    # cause a network partition to isolate node 3
+    nt.cut(1, 3)
+    nt.cut(2, 3)
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+
+    # node 3 campaigns in isolation repeatedly (pre-vote: term stays)
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    assert nt.peers[3].raft.state == StateRole.PreCandidate
+    # pre-vote: the isolated node never bumps its term
+    assert nt.peers[3].raft.term < nt.peers[1].raft.term
+
+    # heal; a heartbeat resumes node 3 (its pre-candidacy yields to the
+    # same-term leader) and the cluster keeps functioning
+    nt.recover()
+    nt.send([new_message(1, 1, MessageType.MsgBeat)])
+    nt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[3].raft.state == StateRole.Follower
+    assert nt.peers[3].raft.term == nt.peers[1].raft.term
+
+
+def test_restore_with_learner():
+    """reference: test_raft.rs:3947-3974"""
+    s = new_snapshot(11, 11, [1, 2])
+    s.metadata.conf_state.learners = [3]
+
+    storage = MemStorage()
+    storage.initialize_with_conf_state(([1, 2], [3]))
+    cfg = new_test_config(3, 10, 1)
+    sm = new_test_raft_with_config(cfg, storage)
+    assert not sm.raft.promotable
+
+    assert sm.raft.restore(s.clone())
+    assert sm.raft_log.last_index() == 11
+    assert sm.raft_log.term(11) == 11
+    assert sorted(sm.raft.prs.conf.voters.ids()) == [1, 2]
+    assert sorted(sm.raft.prs.conf.learners) == [3]
+    assert not sm.raft.promotable
+    # idempotent
+    assert not sm.raft.restore(s)
+
+
+def test_restore_with_voters_outgoing():
+    """reference: test_raft.rs:3976-3996"""
+    s = new_snapshot(11, 11, [2, 3, 4])
+    s.metadata.conf_state.voters_outgoing = [1, 2, 3]
+
+    sm = new_test_raft(1, [1, 2], 10, 1)
+    assert sm.raft.restore(s.clone())
+    assert sm.raft_log.last_index() == 11
+    assert sm.raft.prs.conf.voters.ids() == {1, 2, 3, 4}
+    assert not sm.raft.restore(s)
+
+
+def test_restore_depromote_voter():
+    """A snapshot demoting us to learner is still restorable
+    (reference: test_raft.rs:3998-4007)."""
+    s = new_snapshot(11, 11, [1, 2])
+    s.metadata.conf_state.learners = [3]
+    sm = new_test_raft(3, [1, 2, 3], 10, 1)
+    assert sm.raft.promotable
+    assert sm.raft.restore(s)
+    assert not sm.raft.promotable
+
+
+def test_restore_learner_promotion():
+    """reference: test_raft.rs:4023-4032"""
+    s = new_snapshot(11, 11, [1, 2, 3])
+    storage = MemStorage()
+    storage.initialize_with_conf_state(([1, 2], [3]))
+    sm = new_test_raft_with_config(new_test_config(3, 10, 1), storage)
+    assert not sm.raft.promotable
+    assert sm.raft.restore(s)
+    assert sm.raft.promotable
+
+
+def test_learner_receive_snapshot():
+    """reference: test_raft.rs:4034-4072"""
+    s = new_snapshot(11, 11, [1])
+    s.metadata.conf_state.learners = [2]
+    store = new_storage()
+    n1_storage = MemStorage()
+    n1_storage.initialize_with_conf_state(([1], [2]))
+    n1 = new_test_raft_with_config(new_test_config(1, 10, 1), n1_storage)
+    n1.raft.restore(s)
+    n1.persist()
+
+    n2_storage = MemStorage()
+    n2_storage.initialize_with_conf_state(([1], [2]))
+    n2 = new_test_raft_with_config(new_test_config(2, 10, 1), n2_storage)
+
+    nt = Network.new([n1, n2])
+    timeout = nt.peers[1].raft.randomized_election_timeout
+    nt.peers[1].raft.set_randomized_election_timeout(timeout)
+    for _ in range(timeout):
+        nt.peers[1].raft.tick()
+    nt.peers[1].persist()
+    nt.send(nt.filter(nt.peers[1].read_messages()))
+    nt.send([new_message(1, 1, MessageType.MsgBeat)])
+
+    assert nt.peers[1].raft_log.committed == nt.peers[2].raft_log.committed
+
+
+def test_election_tick_range():
+    """Randomized timeouts stay in [et, 2et) and cover the range
+    (reference: test_raft.rs:4249-4286)."""
+    cfg = new_test_config(1, 10, 1)
+    storage = MemStorage.new_with_conf_state(([1, 2, 3], []))
+    r = new_test_raft_with_config(cfg, storage).raft
+    seen = set()
+    for term in range(1000):
+        r.term = term
+        r.reset_randomized_election_timeout()
+        t = r.randomized_election_timeout
+        assert cfg.election_tick <= t < 2 * cfg.election_tick
+        seen.add(t)
+    assert len(seen) >= cfg.election_tick - 2
+
+    # explicit min/max bounds are honored
+    cfg.min_election_tick = cfg.election_tick + 2
+    cfg.max_election_tick = cfg.election_tick + 5
+    cfg.validate()
+    storage = MemStorage.new_with_conf_state(([1, 2, 3], []))
+    r = new_test_raft_with_config(cfg, storage).raft
+    for term in range(100):
+        r.term = term
+        r.reset_randomized_election_timeout()
+        t = r.randomized_election_timeout
+        assert cfg.min_election_tick <= t < cfg.max_election_tick
+
+    # invalid ranges rejected
+    bad = new_test_config(1, 10, 1)
+    bad.min_election_tick = 5
+    with pytest.raises(ConfigInvalid):
+        bad.validate()
