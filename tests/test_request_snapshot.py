@@ -1,0 +1,209 @@
+"""Follower-requested snapshot cluster scenarios (ported behaviors from
+reference: test_raft.rs:4798-5090)."""
+
+from raft_tpu import (
+    Entry,
+    MemStorage,
+    MessageType,
+    ProgressState,
+    StateRole,
+)
+from raft_tpu.harness import Interface, Network
+from raft_tpu.raft import Raft
+
+from test_util import (
+    new_message,
+    new_message_with_entries,
+    new_snapshot,
+    new_test_config,
+)
+
+
+def index_term_11(id, ids):
+    store = MemStorage()
+    with store.wl() as core:
+        core.apply_snapshot(new_snapshot(11, 11, list(ids)))
+    cfg = new_test_config(id, 5, 1)
+    cfg.max_inflight_msgs = 256
+    from raft_tpu.raft_log import NO_LIMIT
+
+    cfg.max_size_per_msg = NO_LIMIT
+    raft = Raft(cfg, store)
+    raft.reset(11)
+    return Interface(raft)
+
+
+def prepare_request_snapshot():
+    """reference: test_raft.rs:4798-4850"""
+    nt = Network.new(
+        [
+            index_term_11(1, [1, 2, 3]),
+            index_term_11(2, [1, 2, 3]),
+            index_term_11(3, [1, 2, 3]),
+        ]
+    )
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    msg = new_message_with_entries(
+        1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")]
+    )
+    nt.send([
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")]),
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")]),
+    ])
+    assert nt.peers[1].raft_log.committed == 14
+    assert nt.peers[2].raft_log.committed == 14
+
+    ents = list(nt.peers[1].raft_log.unstable_entries())
+    if ents:
+        with nt.storage[1].wl() as core:
+            core.append(ents)
+    with nt.storage[1].wl() as core:
+        core.commit_to(14)
+    nt.peers[1].raft_log.applied = 14
+
+    # Commit one more entry.
+    nt.send([
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")])
+    ])
+    s = nt.storage[1].snapshot(0)
+    return nt, s
+
+
+def test_follower_request_snapshot():
+    """reference: test_raft.rs:4854-4901"""
+    nt, s = prepare_request_snapshot()
+
+    prev_snapshot_idx = s.metadata.index
+    request_idx = nt.peers[1].raft_log.committed
+    assert prev_snapshot_idx < request_idx
+    nt.peers[2].raft.request_snapshot(request_idx)
+
+    req_snap = nt.peers[2].raft.msgs.pop()
+    assert req_snap.msg_type == MessageType.MsgAppendResponse
+    assert req_snap.reject
+    assert req_snap.request_snapshot == request_idx
+    nt.peers[1].step(req_snap)
+
+    # New proposals don't replicate to peer 2 (Snapshot state pauses it).
+    msg = new_message_with_entries(
+        1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")]
+    )
+    nt.send([msg])
+    assert nt.peers[1].raft_log.committed == 16
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Snapshot
+    assert nt.peers[2].raft_log.committed == 15
+
+    # Snapshot reported OK; heartbeat resumes replication; next proposal
+    # flows through.
+    nt.send([new_message(2, 1, MessageType.MsgSnapStatus)])
+    nt.send([new_message(2, 1, MessageType.MsgHeartbeatResponse)])
+    nt.send([
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")])
+    ])
+    assert nt.peers[1].raft_log.committed == 17
+    assert nt.peers[2].raft_log.committed == 17
+
+
+def test_request_snapshot_unavailable():
+    """reference: test_raft.rs:4903-4959"""
+    nt, s = prepare_request_snapshot()
+
+    request_idx = nt.peers[1].raft_log.committed
+    nt.peers[2].raft.request_snapshot(request_idx)
+    req_snap = nt.peers[2].raft.msgs.pop()
+
+    # Temporarily unavailable: peer 2 drops to Probe.
+    with nt.peers[1].raft.store.wl() as core:
+        core.trigger_snap_unavailable_once()
+    nt.peers[1].step(
+        _clone_msg(req_snap)
+    )
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Probe
+
+    with nt.peers[1].raft.store.wl() as core:
+        core.trigger_snap_unavailable_once()
+    nt.peers[1].step(_clone_msg(req_snap))
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Probe
+
+    # Available again: the repeated request is NOT considered stale.
+    nt.peers[1].step(_clone_msg(req_snap))
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Snapshot
+
+
+def _clone_msg(m):
+    import copy
+
+    return copy.deepcopy(m)
+
+
+def test_request_snapshot_matched_change():
+    """reference: test_raft.rs:4961-5003"""
+    nt, _ = prepare_request_snapshot()
+    nt.peers[2].raft_log.committed -= 1
+
+    request_idx = nt.peers[2].raft_log.committed
+    nt.peers[2].raft.request_snapshot(request_idx)
+    req_snap = nt.peers[2].raft.msgs.pop()
+    # Out-of-order request snapshot is ignored.
+    nt.peers[1].step(req_snap)
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Replicate
+
+    # The heartbeat response carries the request again.
+    for _ in range(nt.peers[1].raft.heartbeat_timeout):
+        nt.peers[1].raft.tick()
+    msg_hb = [m for m in nt.peers[1].raft.msgs if m.to == 2][0]
+    nt.peers[1].raft.msgs = []
+    nt.peers[2].step(_clone_msg(msg_hb))
+    req_snap = nt.peers[2].raft.msgs.pop()
+    nt.peers[1].step(req_snap)
+    assert nt.peers[1].raft.prs.get(2).state == ProgressState.Snapshot
+
+
+def test_request_snapshot_none_replicate():
+    """reference: test_raft.rs:5005-5026"""
+    nt, _ = prepare_request_snapshot()
+    nt.peers[1].raft.prs.get_mut(2).state = ProgressState.Probe
+
+    request_idx = nt.peers[2].raft_log.committed
+    nt.peers[2].raft.request_snapshot(request_idx)
+    req_snap = nt.peers[2].raft.msgs.pop()
+    nt.peers[1].step(req_snap)
+    assert nt.peers[1].raft.prs.get(2).pending_request_snapshot != 0
+
+
+def test_request_snapshot_step_down():
+    """reference: test_raft.rs:5029-5056"""
+    nt, _ = prepare_request_snapshot()
+
+    # Commit an entry while 2 is isolated; elect 3.
+    nt.isolate(2)
+    nt.send([
+        new_message_with_entries(1, 1, MessageType.MsgPropose, [Entry(data=b"testdata")])
+    ])
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    assert nt.peers[3].raft.state == StateRole.Leader
+
+    nt.recover()
+    request_idx = nt.peers[2].raft_log.committed
+    nt.peers[2].raft.request_snapshot(request_idx)
+    nt.send([new_message(3, 3, MessageType.MsgBeat)])
+    # The new leader's traffic cancels the stale pending request.
+    assert nt.peers[2].raft.pending_request_snapshot == 0
+
+
+def test_request_snapshot_on_role_change():
+    """reference: test_raft.rs:5059-5090"""
+    nt, _ = prepare_request_snapshot()
+
+    request_idx = nt.peers[2].raft_log.committed
+    nt.peers[2].raft.request_snapshot(request_idx)
+
+    # become_follower preserves pending_request_snapshot...
+    term, id = nt.peers[1].raft.term, nt.peers[1].raft.id
+    nt.peers[2].raft.become_follower(term, id)
+    assert nt.peers[2].raft.pending_request_snapshot != 0
+
+    # ...but campaigning resets it.
+    nt.peers[2].raft.become_candidate()
+    assert nt.peers[2].raft.pending_request_snapshot == 0
