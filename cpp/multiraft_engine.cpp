@@ -54,8 +54,12 @@ struct Peer {
 
 struct Group {
   std::vector<Peer> peers;
-  std::vector<int32_t> matched;  // acting leader's tracker
-  int32_t term_start_index = 0;
+  // Per-OWNER tracker rows: every peer that has ever led keeps its own
+  // frozen Progress.matched view + its noop index, exactly like the scalar
+  // per-peer ProgressTracker (a stale leader resuming command must use ITS
+  // view, not the latest regime's).
+  std::vector<std::vector<int32_t>> matched;  // [P_owner][P_target]
+  std::vector<int32_t> term_start_index;      // [P_owner]
 };
 
 struct Engine {
@@ -72,7 +76,8 @@ struct Engine {
     for (int gi = 0; gi < G; ++gi) {
       auto& grp = groups[gi];
       grp.peers.resize(P);
-      grp.matched.assign(P, 0);
+      grp.matched.assign(P, std::vector<int32_t>(P, 0));
+      grp.term_start_index.assign(P, 0);
       for (int pi = 0; pi < P; ++pi) {
         grp.peers[pi].randomized_timeout =
             timeout_draw(node_key(gi, pi), 0, election_tick, 2 * election_tick);
@@ -189,11 +194,12 @@ struct Engine {
             timeout_draw(node_key(gi, winner), w.term, lo, hi);
         w.election_elapsed = 0;
         w.heartbeat_elapsed = 0;
-        // noop entry (reference: raft.rs:1190-1194)
+        // noop entry (reference: raft.rs:1190-1194); become_leader resets
+        // the winner's OWN tracker row only.
         w.last_index += 1;
         w.last_term = t_star;
-        grp.term_start_index = w.last_index;
-        std::fill(grp.matched.begin(), grp.matched.end(), 0);
+        grp.term_start_index[winner] = w.last_index;
+        std::fill(grp.matched[winner].begin(), grp.matched[winner].end(), 0);
       }
     }
 
@@ -216,8 +222,10 @@ struct Engine {
     }
     if (!sent) return;
 
-    // sync alive peers with term <= leader's; collect acks.
-    grp.matched[lidx] = lead.last_index;
+    // sync alive peers with term <= leader's; acks land in the acting
+    // leader's OWN tracker row.
+    auto& row = grp.matched[lidx];
+    row[lidx] = lead.last_index;
     for (int p = 0; p < P; ++p) {
       if (p == lidx || crashed[p]) continue;
       Peer& f = ps[p];
@@ -233,15 +241,16 @@ struct Engine {
       f.election_elapsed = 0;
       f.last_index = lead.last_index;
       f.last_term = lead.last_term;
-      grp.matched[p] = f.last_index;
+      row[p] = f.last_index;
     }
 
-    // quorum commit, gated on current-term entries
+    // quorum commit, gated on the owner's current-term entries
     // (reference: majority.rs:70-124 + raft_log.rs:487-499).
-    std::vector<int32_t> sorted(grp.matched);
+    std::vector<int32_t> sorted(row);
     std::sort(sorted.begin(), sorted.end(), std::greater<int32_t>());
     int32_t mci = sorted[P / 2];  // quorum-th largest
-    if (mci >= grp.term_start_index && mci > lead.commit) lead.commit = mci;
+    if (mci >= grp.term_start_index[lidx] && mci > lead.commit)
+      lead.commit = mci;
     for (int p = 0; p < P; ++p) {
       if (p == lidx || crashed[p]) continue;
       if (ps[p].term == lead_term && ps[p].state == ROLE_FOLLOWER &&

@@ -177,19 +177,30 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
     )
 
     def fn(st: SimState, crashed: jnp.ndarray, append_n: jnp.ndarray) -> SimState:
-        ee, hb, li, lt, matched, commit = call(
+        # The acting leader is fixed for the whole steady horizon (no
+        # elections, constant crash mask), so its tracker row is gathered
+        # once outside the kernel and scattered back after.
+        is_leader = (st.state == ROLE_LEADER) & ~crashed
+        f = is_leader.astype(jnp.int32)
+        acting_row = jnp.sum(st.matched * f[:, None, :], axis=0)  # [P, G]
+        ts_acting = jnp.sum(st.term_start_index * f, axis=0)  # [G]
+
+        ee, hb, li, lt, new_row, commit = call(
             st.state,
             st.term,
             st.election_elapsed,
             st.heartbeat_elapsed,
             st.last_index,
             st.last_term,
-            st.matched,
+            acting_row,
             st.commit,
             st.voter_mask.astype(jnp.int32),
             crashed.astype(jnp.int32),
-            st.term_start_index[None, :],
+            ts_acting[None, :],
             append_n[None, :],
+        )
+        matched = jnp.where(
+            is_leader[:, None, :], new_row[None, :, :], st.matched
         )
         return st._replace(
             election_elapsed=ee,

@@ -40,12 +40,12 @@ def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
     vector-lane axis of the peer-major [P, G] layout) is sharded; the peer
     axis stays local to the chip."""
     pg = NamedSharding(mesh, P(None, axis))
-    g = NamedSharding(mesh, P(axis))
+    ppg = NamedSharding(mesh, P(None, None, axis))
     return SimState(
         term=pg, state=pg, vote=pg, leader_id=pg,
         election_elapsed=pg, heartbeat_elapsed=pg, randomized_timeout=pg,
         last_index=pg, last_term=pg, commit=pg,
-        matched=pg, term_start_index=g, voter_mask=pg,
+        matched=ppg, term_start_index=pg, voter_mask=pg,
     )
 
 

@@ -77,9 +77,13 @@ class SimState(NamedTuple):
     last_index: jnp.ndarray
     last_term: jnp.ndarray
     commit: jnp.ndarray
-    # Group-level leader bookkeeping:
-    matched: jnp.ndarray  # [P, G] acting leader's Progress.matched view
-    term_start_index: jnp.ndarray  # [G] index of the leader's noop entry
+    # Per-OWNER leader bookkeeping.  Every peer that has ever led keeps its
+    # own frozen ProgressTracker row, exactly like the scalar per-peer
+    # tracker (reference: tracker.rs): when the current leader crashes and a
+    # stale alive leader keeps acting, it must use ITS view of matched /
+    # term-start, not the newer regime's (found by the storm parity test).
+    matched: jnp.ndarray  # [P_owner, P_target, G] Progress.matched views
+    term_start_index: jnp.ndarray  # [P, G] owner's noop index
     voter_mask: jnp.ndarray  # [P, G] static config
 
 
@@ -118,8 +122,8 @@ def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimS
         last_index=zeros(),
         last_term=zeros(),
         commit=zeros(),
-        matched=zeros(),
-        term_start_index=jnp.zeros((G,), jnp.int32),
+        matched=jnp.zeros((P, P, G), jnp.int32),
+        term_start_index=jnp.zeros((P, G), jnp.int32),
         voter_mask=voter_mask,
     )
 
@@ -276,11 +280,12 @@ def step(
         rt_c = jnp.where(step_down, draw(term_c), rt_c)
         ee_c = jnp.where(step_down, 0, ee_c)
 
-        # New leader's tracker resets; alive peers ack the noop in-round
-        # (reference: raft.rs:942-971 + in-round acks).
-        noop_index = jnp.max(jnp.where(won, li_n, 0), axis=0)  # [G]
-        ts_n = jnp.where(winner_exists, noop_index, ts)
-        matched_n = jnp.where(winner_exists, 0, matched)
+        # become_leader resets the winner's OWN tracker row (matched=0; the
+        # self/synced values are written in phase D) and records its noop
+        # index; other owners' frozen rows are untouched
+        # (reference: raft.rs:942-971, 1151-1202).
+        matched_n = jnp.where(won[:, None, :], 0, matched)
+        ts_n = jnp.where(won, li_n, ts)
         return (
             term_c, state_c, vote_c, leader_c, ee_c, hb_c, rt_c,
             li_n, lt_n, matched_n, ts_n, winner_exists,
@@ -349,15 +354,23 @@ def step(
     new_last_index = jnp.where(sync, lead_last, new_last_index)
     new_last_term = jnp.where(sync, lead_last_term, new_last_term)
 
-    # Leader's matched view: acks from every synced peer + its own tail.
-    matched = jnp.where(sync | is_acting_leader, new_last_index, matched)
+    # The acting leader's OWN tracker row: acks from every synced peer + its
+    # own persisted tail.  Other owners' rows stay frozen (they are what a
+    # stale leader resumes with — matching the scalar per-peer trackers).
+    acting_f = is_acting_leader.astype(jnp.int32)  # [P, G]
+    acting_row = jnp.sum(matched * acting_f[:, None, :], axis=0)  # [P_t, G]
+    acting_row = jnp.where(sync | is_acting_leader, new_last_index, acting_row)
+    matched = jnp.where(
+        is_acting_leader[:, None, :], acting_row[None, :, :], matched
+    )
+    ts_acting = jnp.sum(term_start * acting_f, axis=0)  # [G]
 
     # Quorum commit, gated on the entry being from the leader's own term
     # (raft_log.maybe_commit's term check; reference: raft_log.rs:487-499 —
-    # mci >= term_start_index iff term(mci) == lead_term, by log
+    # mci >= the owner's term_start iff term(mci) == lead_term, by log
     # monotonicity).
-    mci = _quorum_index(matched, st.voter_mask)
-    commit_ok = has_leader & (mci >= term_start) & (mci < kernels.INF)
+    mci = _quorum_index(acting_row, st.voter_mask)
+    commit_ok = has_leader & (mci >= ts_acting) & (mci < kernels.INF)
     lead_commit_old = jnp.max(jnp.where(is_acting_leader, st.commit, 0), axis=0)
     lead_commit = jnp.where(
         commit_ok, jnp.maximum(lead_commit_old, mci), lead_commit_old
