@@ -165,6 +165,65 @@ def bench_baseline_configs(results, quick):
         dt = time.perf_counter() - t0
         results.append((name, G * rounds / dt / 1e6, "M ticks/s"))
 
+    if not quick:
+        results.append(bench_config4_joint_churn())
+
+
+def bench_config4_joint_churn():
+    """BASELINE config 4: 100k groups under joint-consensus reconfig churn —
+    every k rounds the membership barrier swaps the voter/outgoing mask
+    planes (enter-joint / leave-joint), exercising the JointConfig commit
+    path + device mask rematerialization."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.multiraft import sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    G, P = 100_000, 5
+    cfg = SimConfig(n_groups=G, n_peers=P)
+    # joint: incoming {1,2,3} && outgoing {3,4,5}; simple: {1,2,3}
+    vm = np.zeros((P, G), bool)
+    vm[:3] = True
+    om_joint = np.zeros((P, G), bool)
+    om_joint[2:] = True
+    om_none = np.zeros((P, G), bool)
+    st = sim.init_state(cfg, jnp.asarray(vm), jnp.asarray(om_joint))
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    step = functools.partial(sim.step, cfg)
+
+    k = 10
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi(st):
+        def body(s, _):
+            return step(s, crashed, append), ()
+
+        return jax.lax.scan(body, st, None, length=k)[0]
+
+    st = multi(st)
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    swaps = 10
+    for i in range(swaps):
+        # membership barrier: leave/enter joint — host re-uploads the mask
+        # planes (donation consumes the previous buffers, like a real
+        # reconfig barrier would re-materialize them)
+        om = om_none if i % 2 else om_joint
+        st = st._replace(outgoing_mask=jnp.asarray(om))
+        st = multi(st)
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    return (
+        "config4: 100k x 5 joint churn",
+        G * k * swaps / dt / 1e6,
+        "M ticks/s",
+    )
+
 
 def main():
     ap = argparse.ArgumentParser()

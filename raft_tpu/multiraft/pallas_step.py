@@ -109,8 +109,10 @@ def _steady_kernel(
         lead_beat = jnp.any(want_beat & is_leader, axis=0, keepdims=True)
         sent = has_leader & (lead_beat | (n_app > 0))  # [1, B]
 
-        # --- instant in-round sync of alive followers ---
-        sync = sent & alive & ~is_leader
+        # --- instant in-round sync of alive member followers (non-members
+        # are outside the progress map; fast path is non-joint, so
+        # member == voter) ---
+        sync = sent & alive & voter & ~is_leader
         ee = jnp.where(sync, 0, ee)
         li = jnp.where(sync, lead_last, li)
         lt = jnp.where(sync, lead_lt, lt)
@@ -251,7 +253,10 @@ def steady_predicate(
     # 3. alive peers at the leader's term
     lead_term = jnp.max(jnp.where(is_leader, st.term, 0), axis=0)
     terms_ok = jnp.all(jnp.where(alive, st.term == lead_term, True))
-    return no_campaign & one_leader & terms_ok
+    # 4. no joint configs in the batch (the fused kernel computes the
+    # single-majority quorum; joint groups take the general XLA path)
+    not_joint = ~jnp.any(st.outgoing_mask)
+    return no_campaign & one_leader & terms_ok & not_joint
 
 
 def fast_step(cfg: SimConfig):

@@ -84,7 +84,12 @@ class SimState(NamedTuple):
     # term-start, not the newer regime's (found by the storm parity test).
     matched: jnp.ndarray  # [P_owner, P_target, G] Progress.matched views
     term_start_index: jnp.ndarray  # [P, G] owner's noop index
-    voter_mask: jnp.ndarray  # [P, G] static config
+    voter_mask: jnp.ndarray  # [P, G] incoming majority config
+    # Outgoing majority for joint consensus (reference: joint.rs:12-15):
+    # all-False = not joint; decisions then need BOTH majorities (BASELINE
+    # config 4's quorum path).  Conf changes are host-side barriers that
+    # swap these mask planes (SURVEY.md §7 hard-part 5).
+    outgoing_mask: jnp.ndarray  # [P, G]
 
 
 def _node_key(cfg: SimConfig) -> jnp.ndarray:
@@ -95,7 +100,11 @@ def _node_key(cfg: SimConfig) -> jnp.ndarray:
     return g * jnp.uint32(1 << 16) + (p + 1)
 
 
-def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimState:
+def init_state(
+    cfg: SimConfig,
+    voter_mask: Optional[jnp.ndarray] = None,
+    outgoing_mask: Optional[jnp.ndarray] = None,
+) -> SimState:
     """All peers start as followers at term 0 with their deterministic
     timeout draw (mirrors Raft.__init__ -> become_follower(0))."""
     G, P = cfg.n_groups, cfg.n_peers
@@ -108,6 +117,8 @@ def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimS
 
     if voter_mask is None:
         voter_mask = jnp.ones(shape, bool)
+    if outgoing_mask is None:
+        outgoing_mask = jnp.zeros(shape, bool)
     lo = jnp.full(shape, cfg.min_timeout, jnp.int32)
     hi = jnp.full(shape, cfg.max_timeout, jnp.int32)
     rt = kernels.timeout_draw(_node_key(cfg), jnp.zeros(shape, jnp.uint32), lo, hi)
@@ -125,6 +136,7 @@ def init_state(cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None) -> SimS
         matched=jnp.zeros((P, P, G), jnp.int32),
         term_start_index=jnp.zeros((P, G), jnp.int32),
         voter_mask=voter_mask,
+        outgoing_mask=outgoing_mask,
     )
 
 
@@ -185,12 +197,15 @@ def step(
 
     # ---- Phase A: tick every peer (crashed peers tick too — isolation cuts
     # the network, not their clock), reference: raft.rs:1024-1079.
+    # promotable == voter in either half of a (possibly joint) config
+    # (reference: raft.rs:2609-2610 via JointConfig::contains).
+    promotable = st.voter_mask | st.outgoing_mask
     ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
         st.state,
         st.election_elapsed,
         st.heartbeat_elapsed,
         st.randomized_timeout,
-        st.voter_mask,  # promotable == is a voter
+        promotable,
         cfg.election_tick,
         cfg.heartbeat_tick,
     )
@@ -214,9 +229,10 @@ def step(
         any_req = jnp.any(req, axis=0)  # [G]
         t_star = jnp.max(jnp.where(req, term, 0), axis=0)  # [G]
 
-        # Receiving a higher-term request makes any alive peer a follower at
-        # that term with vote cleared (reference: raft.rs:1284-1348).
-        bump = alive & (term < t_star) & any_req
+        # Receiving a higher-term request makes any alive MEMBER a follower
+        # at that term with vote cleared (reference: raft.rs:1284-1348;
+        # non-members are outside the progress map and receive no traffic).
+        bump = alive & promotable & (term < t_star) & any_req
         term_c = jnp.where(bump, t_star, term)
         state_c = jnp.where(bump, ROLE_FOLLOWER, state)
         vote_c = jnp.where(bump, 0, vote)
@@ -242,24 +258,36 @@ def step(
 
         c_idx = jnp.arange(P, dtype=jnp.int32)[:, None, None]
         first_elig = jnp.min(jnp.where(elig, c_idx, P), axis=0)  # [v, G]
-        # Voters respond only if alive, a voter, and at exactly t_star after
-        # the bump (peers with higher terms silently ignore stale requests).
-        responder = alive & st.voter_mask & (term_c == t_star) & any_req
+        # Voters (either half of the config) respond only if alive and at
+        # exactly t_star after the bump (peers with higher terms silently
+        # ignore stale requests).
+        responder = alive & promotable & (term_c == t_star) & any_req
         can_vote = (vote_c == 0) & responder
         grant_to = jnp.where(can_vote & (first_elig < P), first_elig, -1)
+        granted_v = (grant_to[None, :, :] == c_idx) & (
+            grant_to[None, :, :] >= 0
+        )  # [c, v, G]
 
-        # votes_for[c] = grants + self-vote.
-        grants = jnp.sum(
-            (grant_to[None, :, :] == c_idx) & (grant_to[None, :, :] >= 0),
-            axis=1,
-        ).astype(jnp.int32)
-        votes_for = grants + cand.astype(jnp.int32)
-        n_voters = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)  # [G]
-        n_responders = jnp.sum(responder, axis=0).astype(jnp.int32)
-        quorum = n_voters // 2 + 1
-        missing = n_voters - n_responders
-        won = cand & (votes_for >= quorum)
-        lost = cand & (votes_for + missing < quorum)
+        # Joint tally: a candidate wins iff it wins BOTH majorities and
+        # loses if it loses EITHER (reference: joint.rs:56-67; an empty
+        # half wins by convention, majority.rs:131-136).
+        def tally(mask):
+            grants = jnp.sum(granted_v & mask[None, :, :], axis=1).astype(
+                jnp.int32
+            )
+            votes_for = grants + (cand & mask).astype(jnp.int32)
+            n = jnp.sum(mask, axis=0).astype(jnp.int32)  # [G]
+            q = n // 2 + 1
+            resp = jnp.sum(responder & mask, axis=0).astype(jnp.int32)
+            missing = n - resp
+            won_h = (votes_for >= q) | (n == 0)
+            lost_h = (votes_for + missing < q) & (n > 0)
+            return won_h, lost_h
+
+        won_i, lost_i = tally(st.voter_mask)
+        won_o, lost_o = tally(st.outgoing_mask)
+        won = cand & won_i & won_o
+        lost = cand & (lost_i | lost_o)
 
         winner_exists = jnp.any(won, axis=0)  # [G]
 
@@ -340,9 +368,10 @@ def step(
     lead_beat = jnp.any(want_heartbeat & is_acting_leader, axis=0)
     sent = has_leader & (lead_beat | (n_app > 0) | winner_exists)
 
-    # Peers that sync to the leader this round: alive, reachable terms
-    # (term <= leader's — higher-term peers ignore), not the leader itself.
-    sync = sent & alive & (term <= lead_term) & ~is_acting_leader
+    # Peers that sync to the leader this round: alive config members with
+    # reachable terms (term <= leader's — higher-term peers ignore), not the
+    # leader itself (non-members are outside the progress map: no traffic).
+    sync = sent & alive & promotable & (term <= lead_term) & ~is_acting_leader
     term_bumped = sync & (term < lead_term)
     term_d = jnp.where(sync, lead_term, term)
     state_d = jnp.where(sync, ROLE_FOLLOWER, state)
@@ -365,11 +394,16 @@ def step(
     )
     ts_acting = jnp.sum(term_start * acting_f, axis=0)  # [G]
 
-    # Quorum commit, gated on the entry being from the leader's own term
-    # (raft_log.maybe_commit's term check; reference: raft_log.rs:487-499 —
-    # mci >= the owner's term_start iff term(mci) == lead_term, by log
-    # monotonicity).
-    mci = _quorum_index(acting_row, st.voter_mask)
+    # Quorum commit: jointly committed = min over both majorities
+    # (reference: joint.rs:47-51; an empty outgoing half returns INF so the
+    # min reduces to the incoming half), gated on the entry being from the
+    # leader's own term (raft_log.maybe_commit's term check; reference:
+    # raft_log.rs:487-499 — mci >= the owner's term_start iff
+    # term(mci) == lead_term, by log monotonicity).
+    mci = jnp.minimum(
+        _quorum_index(acting_row, st.voter_mask),
+        _quorum_index(acting_row, st.outgoing_mask),
+    )
     commit_ok = has_leader & (mci >= ts_acting) & (mci < kernels.INF)
     lead_commit_old = jnp.max(jnp.where(is_acting_leader, st.commit, 0), axis=0)
     lead_commit = jnp.where(
@@ -393,6 +427,7 @@ def step(
         matched=matched,
         term_start_index=term_start,
         voter_mask=st.voter_mask,
+        outgoing_mask=st.outgoing_mask,
     )
 
 
@@ -401,9 +436,14 @@ class ClusterSim:
     peer-major [P, G]; `snapshot_gp()` returns the [G, P] view for parity
     comparisons."""
 
-    def __init__(self, cfg: SimConfig, voter_mask: Optional[jnp.ndarray] = None):
+    def __init__(
+        self,
+        cfg: SimConfig,
+        voter_mask: Optional[jnp.ndarray] = None,
+        outgoing_mask: Optional[jnp.ndarray] = None,
+    ):
         self.cfg = cfg
-        self.state = init_state(cfg, voter_mask)
+        self.state = init_state(cfg, voter_mask, outgoing_mask)
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
 
     def run_round(self, crashed=None, append_n=None) -> SimState:

@@ -27,12 +27,13 @@ from ..harness import Interface, Network
 
 class ScalarCluster:
     def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
-                 heartbeat_tick: int = 1):
+                 heartbeat_tick: int = 1, voters=None, voters_outgoing=None):
+        """`voters`/`voters_outgoing` (peer-id lists) bootstrap every group
+        in that (possibly joint) configuration; default: all peers voters."""
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.networks: List[Network] = []
         for g in range(n_groups):
-            peers: List[Optional[Interface]] = [None] * n_peers
             config = Config(
                 election_tick=election_tick,
                 heartbeat_tick=heartbeat_tick,
@@ -40,7 +41,24 @@ class ScalarCluster:
                 max_inflight_msgs=1 << 20,  # effectively unbounded window
                 timeout_seed=g,
             )
-            self.networks.append(Network.new_with_config(peers, config))
+            if voters is None:
+                peers: List[Optional[Interface]] = [None] * n_peers
+                self.networks.append(Network.new_with_config(peers, config))
+            else:
+                from ..raft import Raft
+
+                ifaces = []
+                for id in range(1, n_peers + 1):
+                    cs = ConfState(
+                        voters=list(voters),
+                        voters_outgoing=list(voters_outgoing or []),
+                    )
+                    store = MemStorage.new_with_conf_state(cs)
+                    cfg = Config(**{**config.__dict__, "id": id})
+                    ifaces.append(Interface(Raft(cfg, store)))
+                self.networks.append(
+                    Network.new_with_config(ifaces, config)
+                )
 
     def _apply_crash_mask(self, net: Network, crashed_row: Sequence[bool]) -> None:
         net.recover()

@@ -1,0 +1,107 @@
+"""Joint-consensus device path (BASELINE config 4's quorum math): groups
+running IN a joint configuration must elect and commit through BOTH
+majorities, bit-identical to scalar Rafts bootstrapped with the same
+ConfState (voters + voters_outgoing)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
+
+FIELDS = ("term", "state", "commit", "last_index", "last_term")
+
+
+def masks(P, G, incoming, outgoing):
+    vm = np.zeros((P, G), bool)
+    om = np.zeros((P, G), bool)
+    for id in incoming:
+        vm[id - 1, :] = True
+    for id in outgoing:
+        om[id - 1, :] = True
+    return jnp.asarray(vm), jnp.asarray(om)
+
+
+def run_parity(G, P, incoming, outgoing, rounds, schedule):
+    scalar = ScalarCluster(G, P, voters=incoming, voters_outgoing=outgoing)
+    vm, om = masks(P, G, incoming, outgoing)
+    sim = ClusterSim(SimConfig(n_groups=G, n_peers=P), vm, om)
+    for r in range(rounds):
+        crashed, append = schedule(r)
+        scalar.round(crashed, append)
+        sim.run_round(jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32))
+        want = scalar.snapshot()
+        for f in FIELDS:
+            got = np.asarray(getattr(sim.state, f), dtype=np.int64).T
+            if not np.array_equal(want[f], got):
+                bad = np.argwhere(want[f] != got)[0]
+                raise AssertionError(
+                    f"round {r} field {f} group {bad[0]} peer {bad[1]}: "
+                    f"scalar={want[f][bad[0], bad[1]]} device={got[bad[0], bad[1]]}"
+                )
+    return scalar, sim
+
+
+def test_joint_quiet_commit():
+    """incoming {1,2,3}, outgoing {3,4,5}: commits need both majorities."""
+    G, P = 6, 5
+
+    def schedule(r):
+        return np.zeros((G, P), bool), np.full(G, 1, np.int64)
+
+    scalar, sim = run_parity(G, P, [1, 2, 3], [3, 4, 5], 50, schedule)
+    snap = scalar.snapshot()
+    assert (snap["commit"].max(axis=1) > 0).all()
+
+
+def test_joint_outgoing_majority_crash_stalls_commit():
+    """Killing the outgoing majority must stall commits even though the
+    incoming majority is healthy — the signature joint-consensus property."""
+    G, P = 4, 5
+    incoming, outgoing = [1, 2, 3], [3, 4, 5]
+    stall_commit = {}
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if 30 <= r < 70:
+            crashed[:, 3] = True  # peer 4
+            crashed[:, 4] = True  # peer 5 -> outgoing majority gone
+        return crashed, np.full(G, 1, np.int64)
+
+    scalar, sim = run_parity(G, P, incoming, outgoing, 90, schedule)
+
+
+def test_joint_elections_require_both_majorities():
+    """With the outgoing majority crashed from the start, nobody can win an
+    election despite a healthy incoming majority."""
+    G, P = 4, 5
+    incoming, outgoing = [1, 2], [3, 4, 5]
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        crashed[:, 2] = True
+        crashed[:, 3] = True
+        crashed[:, 4] = True
+        return crashed, np.zeros(G, np.int64)
+
+    scalar, sim = run_parity(G, P, incoming, outgoing, 60, schedule)
+    snap = scalar.snapshot()
+    # leaderless: incoming majority alone can't win the joint vote
+    assert (snap["state"] != 2).all()
+
+
+def test_joint_crash_churn_parity():
+    G, P = 4, 5
+    incoming, outgoing = [1, 2, 3], [2, 3, 4]
+    rng = np.random.RandomState(77)
+    crashed = np.zeros((G, P), bool)
+
+    def schedule(r):
+        for g in range(G):
+            if rng.rand() < 0.06:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        return crashed.copy(), rng.randint(0, 2, size=G).astype(np.int64)
+
+    run_parity(G, P, incoming, outgoing, 100, schedule)
