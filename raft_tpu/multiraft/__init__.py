@@ -40,4 +40,10 @@ __all__ = [
     "SimConfig",
     "SimState",
     "ScalarCluster",
+    # submodules imported lazily to keep jax-light paths cheap:
+    #   .driver    MultiRaft host driver
+    #   .native    NativeMultiRaft C++ engine bindings
+    #   .pallas_step  fused steady-round kernels
+    #   .checkpoint   save/load device state
+    #   .sharding     mesh + sharded step + global status
 ]

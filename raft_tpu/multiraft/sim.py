@@ -433,8 +433,7 @@ def step(
 
 class ClusterSim:
     """Convenience wrapper: jitted step + host-friendly runners.  Arrays are
-    peer-major [P, G]; `snapshot_gp()` returns the [G, P] view for parity
-    comparisons."""
+    peer-major [P, G]."""
 
     def __init__(
         self,
