@@ -52,6 +52,7 @@ def _steady_kernel(
     matched_ref,
     commit_ref,
     voter_ref,
+    member_ref,
     crashed_ref,
     ts_ref,
     app_ref,
@@ -77,6 +78,7 @@ def _steady_kernel(
     matched = matched_ref[...]
     commit = commit_ref[...]
     voter = voter_ref[...] != 0
+    member = member_ref[...] != 0
     crashed = crashed_ref[...] != 0
     term_start = ts_ref[...]  # [1, BLOCK]
     app = app_ref[...]  # [1, BLOCK]
@@ -109,10 +111,9 @@ def _steady_kernel(
         lead_beat = jnp.any(want_beat & is_leader, axis=0, keepdims=True)
         sent = has_leader & (lead_beat | (n_app > 0))  # [1, B]
 
-        # --- instant in-round sync of alive member followers (non-members
-        # are outside the progress map; fast path is non-joint, so
-        # member == voter) ---
-        sync = sent & alive & voter & ~is_leader
+        # --- instant in-round sync of alive member followers (voters +
+        # learners; non-members are outside the progress map) ---
+        sync = sent & alive & member & ~is_leader
         ee = jnp.where(sync, 0, ee)
         li = jnp.where(sync, lead_last, li)
         lt = jnp.where(sync, lead_lt, lt)
@@ -173,7 +174,7 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
     call = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[pg_spec] * 10 + [g_spec] * 2,
+        in_specs=[pg_spec] * 11 + [g_spec] * 2,
         out_specs=[pg_spec] * 6,
         out_shape=[jax.ShapeDtypeStruct((P, G), jnp.int32)] * 6,
     )
@@ -197,6 +198,7 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
             acting_row,
             st.commit,
             st.voter_mask.astype(jnp.int32),
+            (st.voter_mask | st.learner_mask).astype(jnp.int32),
             crashed.astype(jnp.int32),
             ts_acting[None, :],
             append_n[None, :],

@@ -90,6 +90,9 @@ class SimState(NamedTuple):
     # config 4's quorum path).  Conf changes are host-side barriers that
     # swap these mask planes (SURVEY.md §7 hard-part 5).
     outgoing_mask: jnp.ndarray  # [P, G]
+    # Learners (reference: tracker.rs:40-49): replicated to, never voting,
+    # never campaigning, never counted in quorums.
+    learner_mask: jnp.ndarray  # [P, G]
 
 
 def _node_key(cfg: SimConfig) -> jnp.ndarray:
@@ -104,6 +107,7 @@ def init_state(
     cfg: SimConfig,
     voter_mask: Optional[jnp.ndarray] = None,
     outgoing_mask: Optional[jnp.ndarray] = None,
+    learner_mask: Optional[jnp.ndarray] = None,
 ) -> SimState:
     """All peers start as followers at term 0 with their deterministic
     timeout draw (mirrors Raft.__init__ -> become_follower(0))."""
@@ -119,6 +123,8 @@ def init_state(
         voter_mask = jnp.ones(shape, bool)
     if outgoing_mask is None:
         outgoing_mask = jnp.zeros(shape, bool)
+    if learner_mask is None:
+        learner_mask = jnp.zeros(shape, bool)
     lo = jnp.full(shape, cfg.min_timeout, jnp.int32)
     hi = jnp.full(shape, cfg.max_timeout, jnp.int32)
     rt = kernels.timeout_draw(_node_key(cfg), jnp.zeros(shape, jnp.uint32), lo, hi)
@@ -137,6 +143,7 @@ def init_state(
         term_start_index=jnp.zeros((P, G), jnp.int32),
         voter_mask=voter_mask,
         outgoing_mask=outgoing_mask,
+        learner_mask=learner_mask,
     )
 
 
@@ -198,8 +205,10 @@ def step(
     # ---- Phase A: tick every peer (crashed peers tick too — isolation cuts
     # the network, not their clock), reference: raft.rs:1024-1079.
     # promotable == voter in either half of a (possibly joint) config
-    # (reference: raft.rs:2609-2610 via JointConfig::contains).
+    # (reference: raft.rs:2609-2610 via JointConfig::contains); members
+    # (voters + learners) are who the leader replicates to.
     promotable = st.voter_mask | st.outgoing_mask
+    member = promotable | st.learner_mask
     ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
         st.state,
         st.election_elapsed,
@@ -229,9 +238,9 @@ def step(
         any_req = jnp.any(req, axis=0)  # [G]
         t_star = jnp.max(jnp.where(req, term, 0), axis=0)  # [G]
 
-        # Receiving a higher-term request makes any alive MEMBER a follower
+        # Receiving a higher-term request makes any alive VOTER a follower
         # at that term with vote cleared (reference: raft.rs:1284-1348;
-        # non-members are outside the progress map and receive no traffic).
+        # campaign() sends requests only to voters, raft.rs:1238).
         bump = alive & promotable & (term < t_star) & any_req
         term_c = jnp.where(bump, t_star, term)
         state_c = jnp.where(bump, ROLE_FOLLOWER, state)
@@ -368,10 +377,11 @@ def step(
     lead_beat = jnp.any(want_heartbeat & is_acting_leader, axis=0)
     sent = has_leader & (lead_beat | (n_app > 0) | winner_exists)
 
-    # Peers that sync to the leader this round: alive config members with
-    # reachable terms (term <= leader's — higher-term peers ignore), not the
-    # leader itself (non-members are outside the progress map: no traffic).
-    sync = sent & alive & promotable & (term <= lead_term) & ~is_acting_leader
+    # Peers that sync to the leader this round: alive config members
+    # (voters + learners) with reachable terms (term <= leader's —
+    # higher-term peers ignore), not the leader itself (non-members are
+    # outside the progress map: no traffic).
+    sync = sent & alive & member & (term <= lead_term) & ~is_acting_leader
     term_bumped = sync & (term < lead_term)
     term_d = jnp.where(sync, lead_term, term)
     state_d = jnp.where(sync, ROLE_FOLLOWER, state)
@@ -428,6 +438,7 @@ def step(
         term_start_index=term_start,
         voter_mask=st.voter_mask,
         outgoing_mask=st.outgoing_mask,
+        learner_mask=st.learner_mask,
     )
 
 
@@ -440,9 +451,10 @@ class ClusterSim:
         cfg: SimConfig,
         voter_mask: Optional[jnp.ndarray] = None,
         outgoing_mask: Optional[jnp.ndarray] = None,
+        learner_mask: Optional[jnp.ndarray] = None,
     ):
         self.cfg = cfg
-        self.state = init_state(cfg, voter_mask, outgoing_mask)
+        self.state = init_state(cfg, voter_mask, outgoing_mask, learner_mask)
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
 
     def run_round(self, crashed=None, append_n=None) -> SimState:

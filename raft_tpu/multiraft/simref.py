@@ -27,9 +27,11 @@ from ..harness import Interface, Network
 
 class ScalarCluster:
     def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
-                 heartbeat_tick: int = 1, voters=None, voters_outgoing=None):
-        """`voters`/`voters_outgoing` (peer-id lists) bootstrap every group
-        in that (possibly joint) configuration; default: all peers voters."""
+                 heartbeat_tick: int = 1, voters=None, voters_outgoing=None,
+                 learners=None):
+        """`voters`/`voters_outgoing`/`learners` (peer-id lists) bootstrap
+        every group in that (possibly joint) configuration; default: all
+        peers voters."""
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.networks: List[Network] = []
@@ -52,6 +54,7 @@ class ScalarCluster:
                     cs = ConfState(
                         voters=list(voters),
                         voters_outgoing=list(voters_outgoing or []),
+                        learners=list(learners or []),
                     )
                     store = MemStorage.new_with_conf_state(cs)
                     cfg = Config(**{**config.__dict__, "id": id})

@@ -1,0 +1,108 @@
+"""Learners in the device sim: replicated to, never voting, never counted
+in quorums — parity against scalar Rafts bootstrapped with learner
+ConfStates."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
+
+FIELDS = ("term", "state", "commit", "last_index", "last_term")
+
+
+def run_parity(G, P, voters, learners, rounds, schedule):
+    scalar = ScalarCluster(G, P, voters=voters, learners=learners)
+    vm = np.zeros((P, G), bool)
+    lm = np.zeros((P, G), bool)
+    for id in voters:
+        vm[id - 1, :] = True
+    for id in learners:
+        lm[id - 1, :] = True
+    sim = ClusterSim(
+        SimConfig(n_groups=G, n_peers=P),
+        jnp.asarray(vm),
+        None,
+        jnp.asarray(lm),
+    )
+    for r in range(rounds):
+        crashed, append = schedule(r)
+        scalar.round(crashed, append)
+        sim.run_round(jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32))
+        want = scalar.snapshot()
+        for f in FIELDS:
+            got = np.asarray(getattr(sim.state, f), dtype=np.int64).T
+            if not np.array_equal(want[f], got):
+                bad = np.argwhere(want[f] != got)[0]
+                raise AssertionError(
+                    f"round {r} field {f} group {bad[0]} peer {bad[1]}: "
+                    f"scalar={want[f][bad[0], bad[1]]} device={got[bad[0], bad[1]]}"
+                )
+    return scalar, sim
+
+
+def test_learners_replicate_but_dont_count():
+    """Voters {1,2,3}, learners {4,5}: learners track the log/commit but a
+    3-voter quorum governs."""
+    G, P = 6, 5
+
+    def schedule(r):
+        return np.zeros((G, P), bool), np.full(G, 1, np.int64)
+
+    scalar, sim = run_parity(G, P, [1, 2, 3], [4, 5], 50, schedule)
+    snap = scalar.snapshot()
+    # learners converged to the same commit
+    assert (snap["commit"][:, 3] == snap["commit"][:, 0]).all()
+    # learners never campaigned (state follower, term == leader's)
+    assert (snap["state"][:, 3] == 0).all()
+    assert (snap["state"][:, 4] == 0).all()
+
+
+def test_learner_crash_does_not_stall_commit():
+    """Both learners down: the voter quorum keeps committing."""
+    G, P = 4, 5
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if r >= 20:
+            crashed[:, 3] = True
+            crashed[:, 4] = True
+        return crashed, np.full(G, 1, np.int64)
+
+    scalar, sim = run_parity(G, P, [1, 2, 3], [4, 5], 60, schedule)
+    snap = scalar.snapshot()
+    assert (snap["commit"][:, 0] > 30).all()
+
+
+def test_voter_minority_with_learners_stalls():
+    """Two of three voters down: no quorum regardless of healthy learners."""
+    G, P = 4, 5
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if r >= 20:
+            crashed[:, 1] = True
+            crashed[:, 2] = True
+        return crashed, np.full(G, 1, np.int64)
+
+    scalar, sim = run_parity(G, P, [1, 2, 3], [4, 5], 70, schedule)
+    snap = scalar.snapshot()
+    # Commits froze shortly after the outage: with ~50 healthy rounds they
+    # would be far beyond 30 (one append per round).
+    assert (snap["commit"].max(axis=1) < 30).all()
+
+
+def test_learner_churn_parity():
+    G, P = 4, 5
+    rng = np.random.RandomState(11)
+    crashed = np.zeros((G, P), bool)
+
+    def schedule(r):
+        for g in range(G):
+            if rng.rand() < 0.06:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        return crashed.copy(), rng.randint(0, 2, size=G).astype(np.int64)
+
+    run_parity(G, P, [1, 2, 3], [4, 5], 100, schedule)
