@@ -5,19 +5,19 @@ A TiKV-style multi-raft node is one peer of each of G groups.  The naive
 driver calls `RawNode.tick()` G times per tick interval — an O(G) Python/
 branching loop that dominates CPU at 100k groups even when nothing happens.
 Here the per-group timer state {state, election_elapsed, heartbeat_elapsed,
-randomized_timeout, promotable} is mirrored into device-resident [G] arrays
-and one fused `tick_kernel` advances every group per tick; the host then
-touches ONLY the groups whose masks fired (want_campaign / want_heartbeat /
-election-timeout boundary) plus groups with inbound traffic — the Zipf
+randomized_timeout, promotable} lives in host numpy mirrors; each tick()
+makes ONE device round-trip (upload mirrors → fused tick_kernel → download
+counters + event masks) and then touches ONLY the groups whose masks fired
+(want_campaign / want_heartbeat / election-timeout boundary) — the Zipf
 sparsity BASELINE config #3 banks on.
 
-Consistency contract: the device owns the timers between host events; any
+Consistency contract: the mirrors are authoritative between host events; any
 host interaction with a group (messages, proposals, Ready handling) is
-bracketed by `_sync_to_node` / `_sync_from_node`, which gather/scatter that
-group's row so the scalar RawNode sees exactly the counters `Raft.tick()`
-would have produced (reference: raft.rs:1024-1079 tick semantics, including
-the leader's election-timeout boundary effects: check-quorum step and
-leader-transfer abort, raft.rs:1056-1065).
+bracketed by `_sync_to_node` / `_sync_from_node`, so the scalar RawNode sees
+exactly the counters `Raft.tick()` would have produced (reference:
+raft.rs:1024-1079 tick semantics, including the leader's election-timeout
+boundary effects: check-quorum step and leader-transfer abort,
+raft.rs:1056-1065).
 """
 
 from __future__ import annotations
@@ -56,99 +56,71 @@ class MultiRaft:
         self.election_tick = base_config.election_tick
         self.heartbeat_tick = base_config.heartbeat_tick
 
-        # Device mirrors [G].
-        self._d = {
-            "state": jnp.asarray(
-                np.array([n.raft.state for n in self.nodes], np.int32)
-            ),
-            "ee": jnp.asarray(
-                np.array([n.raft.election_elapsed for n in self.nodes], np.int32)
-            ),
-            "hb": jnp.asarray(
-                np.array(
-                    [n.raft.heartbeat_elapsed for n in self.nodes], np.int32
-                )
-            ),
-            "rt": jnp.asarray(
-                np.array(
-                    [n.raft.randomized_election_timeout for n in self.nodes],
-                    np.int32,
-                )
-            ),
-            "promotable": jnp.asarray(
-                np.array([n.raft.promotable for n in self.nodes], bool)
-            ),
-        }
+        # Host-side mirrors [G] (authoritative between host events).
+        self._state = np.array([n.raft.state for n in self.nodes], np.int32)
+        self._ee = np.array(
+            [n.raft.election_elapsed for n in self.nodes], np.int32
+        )
+        self._hb = np.array(
+            [n.raft.heartbeat_elapsed for n in self.nodes], np.int32
+        )
+        self._rt = np.array(
+            [n.raft.randomized_election_timeout for n in self.nodes], np.int32
+        )
+        self._promotable = np.array(
+            [n.raft.promotable for n in self.nodes], bool
+        )
 
         et, ht = self.election_tick, self.heartbeat_tick
 
         @jax.jit
-        def _tick(d):
-            ee, hb, campaign, beat, checkq = kernels.tick_kernel(
-                d["state"], d["ee"], d["hb"], d["rt"], d["promotable"], et, ht
-            )
-            out = dict(d)
-            out["ee"] = ee
-            out["hb"] = hb
-            return out, campaign, beat, checkq
+        def _tick(state, ee, hb, rt, promotable):
+            return kernels.tick_kernel(state, ee, hb, rt, promotable, et, ht)
 
         self._tick_fn = _tick
 
-    # --- host<->device row sync ---
+    # --- host<->mirror row sync ---
 
-    def _sync_to_node(self, g: int, ee_row: int, hb_row: int) -> None:
+    def _sync_to_node(self, g: int) -> None:
         r = self.nodes[g].raft
-        r.election_elapsed = int(ee_row)
-        r.heartbeat_elapsed = int(hb_row)
+        r.election_elapsed = int(self._ee[g])
+        r.heartbeat_elapsed = int(self._hb[g])
 
-    def _sync_from_nodes(self, groups: Iterable[int]) -> None:
-        groups = list(groups)
-        if not groups:
-            return
-        idx = jnp.asarray(np.asarray(groups, np.int32))
-        vals = {
-            "state": np.array(
-                [self.nodes[g].raft.state for g in groups], np.int32
-            ),
-            "ee": np.array(
-                [self.nodes[g].raft.election_elapsed for g in groups], np.int32
-            ),
-            "hb": np.array(
-                [self.nodes[g].raft.heartbeat_elapsed for g in groups], np.int32
-            ),
-            "rt": np.array(
-                [self.nodes[g].raft.randomized_election_timeout for g in groups],
-                np.int32,
-            ),
-            "promotable": np.array(
-                [self.nodes[g].raft.promotable for g in groups], bool
-            ),
-        }
-        for k, v in vals.items():
-            self._d[k] = self._d[k].at[idx].set(jnp.asarray(v))
+    def _sync_from_node(self, g: int) -> None:
+        r = self.nodes[g].raft
+        self._state[g] = r.state
+        self._ee[g] = r.election_elapsed
+        self._hb[g] = r.heartbeat_elapsed
+        self._rt[g] = r.randomized_election_timeout
+        self._promotable[g] = r.promotable
 
     # --- the batched tick (SURVEY.md §7 kernel k1 in production shape) ---
 
     def tick(self) -> np.ndarray:
-        """Advance every group's logical clock by one tick on device;
-        dispatch tick side effects on the host only for fired groups.
-        Returns the boolean [G] mask of groups with probable readiness."""
-        self._d, campaign, beat, checkq = self._tick_fn(self._d)
+        """Advance every group's logical clock by one tick with a single
+        fused device kernel; dispatch tick side effects on the host only for
+        fired groups.  Returns the boolean [G] mask of active groups."""
+        ee, hb, campaign, beat, checkq = self._tick_fn(
+            jnp.asarray(self._state),
+            jnp.asarray(self._ee),
+            jnp.asarray(self._hb),
+            jnp.asarray(self._rt),
+            jnp.asarray(self._promotable),
+        )
+        # np.array copies: jax array views are read-only.
+        self._ee = np.array(ee)
+        self._hb = np.array(hb)
         campaign = np.asarray(campaign)
         beat = np.asarray(beat)
         checkq = np.asarray(checkq)
         active = campaign | beat | checkq
         if not active.any():
             return active
-        idx = np.nonzero(active)[0]
-        ee = np.asarray(jnp.take(self._d["ee"], jnp.asarray(idx)))
-        hb = np.asarray(jnp.take(self._d["hb"], jnp.asarray(idx)))
-        touched = []
-        for row, g in enumerate(idx):
+        for g in np.nonzero(active)[0]:
             g = int(g)
             node = self.nodes[g]
             r = node.raft
-            self._sync_to_node(g, ee[row], hb[row])
+            self._sync_to_node(g)
             if campaign[g]:
                 # tick_election fired (reference: raft.rs:1037-1047).
                 try:
@@ -170,44 +142,35 @@ class MultiRaft:
                     r.step(new_message(0, MessageType.MsgBeat, r.id))
                 except Exception:
                     pass
-            touched.append(g)
-        self._sync_from_nodes(touched)
+            self._sync_from_node(g)
         return active
 
     # --- host-side per-group interactions (all bracketed by sync) ---
 
     def _host_op(self, g: int, fn: Callable[[RawNode], object]):
-        ee = int(self._d["ee"][g])
-        hb = int(self._d["hb"][g])
-        self._sync_to_node(g, ee, hb)
+        self._sync_to_node(g)
         try:
             return fn(self.nodes[g])
         finally:
-            self._sync_from_nodes([g])
+            self._sync_from_node(g)
 
     def step(self, g: int, m: Message) -> None:
         self._host_op(g, lambda n: n.step(m))
 
     def step_batch(self, msgs: Iterable[Tuple[int, Message]]) -> None:
-        """Deliver a batch of (group, message) pairs with ONE gather/scatter
-        for all touched groups (the DCN inbox path, SURVEY.md §5.8b)."""
+        """Deliver a batch of (group, message) pairs (the DCN inbox path,
+        SURVEY.md §5.8b)."""
         by_group: Dict[int, List[Message]] = {}
         for g, m in msgs:
             by_group.setdefault(g, []).append(m)
-        if not by_group:
-            return
-        groups = sorted(by_group)
-        gidx = jnp.asarray(np.asarray(groups, np.int32))
-        ee = np.asarray(jnp.take(self._d["ee"], gidx))
-        hb = np.asarray(jnp.take(self._d["hb"], gidx))
-        for row, g in enumerate(groups):
-            self._sync_to_node(g, ee[row], hb[row])
+        for g in sorted(by_group):
+            self._sync_to_node(g)
             for m in by_group[g]:
                 try:
                     self.nodes[g].step(m)
                 except Exception:
                     pass
-        self._sync_from_nodes(groups)
+            self._sync_from_node(g)
 
     def propose(self, g: int, context: bytes, data: bytes) -> None:
         self._host_op(g, lambda n: n.propose(context, data))
@@ -236,7 +199,7 @@ class MultiRaft:
     # --- batched introspection (SURVEY.md §5.5 MultiRaftStatus) ---
 
     def status(self) -> Dict[str, int]:
-        states = np.array([n.raft.state for n in self.nodes], np.int32)
+        states = self._state
         commits = np.array(
             [n.raft.raft_log.committed for n in self.nodes], np.int64
         )
