@@ -12,6 +12,7 @@
 // Build: g++ -O3 -shared -fPIC -o libmultiraft.so multiraft_engine.cpp
 
 #include <cstdint>
+#include <climits>
 #include <cstring>
 #include <vector>
 #include <algorithm>
@@ -65,13 +66,25 @@ struct Group {
 struct Engine {
   int32_t G, P, election_tick, heartbeat_tick;
   std::vector<Group> groups;
+  // Config masks [G*P] (joint + learner support; reference: joint.rs,
+  // tracker.rs:40-49).  Defaults: every peer a voter, no joint/learners.
+  std::vector<uint8_t> voter, outgoing, learner;
 
   uint32_t node_key(int g, int p) const {
     return static_cast<uint32_t>(g) * 65536u + static_cast<uint32_t>(p + 1);
   }
 
+  bool vot(int g, int p) const { return voter[size_t(g) * P + p] != 0; }
+  bool outg(int g, int p) const { return outgoing[size_t(g) * P + p] != 0; }
+  bool lrn(int g, int p) const { return learner[size_t(g) * P + p] != 0; }
+  bool promotable(int g, int p) const { return vot(g, p) || outg(g, p); }
+  bool member(int g, int p) const { return promotable(g, p) || lrn(g, p); }
+
   Engine(int32_t g, int32_t p, int32_t et, int32_t ht)
       : G(g), P(p), election_tick(et), heartbeat_tick(ht) {
+    voter.assign(size_t(G) * P, 1);
+    outgoing.assign(size_t(G) * P, 0);
+    learner.assign(size_t(G) * P, 0);
     groups.resize(G);
     for (int gi = 0; gi < G; ++gi) {
       auto& grp = groups[gi];
@@ -110,8 +123,9 @@ struct Engine {
           pr.heartbeat_elapsed = 0;
           want_beat[p] = true;
         }
-      } else if (pr.election_elapsed >= pr.randomized_timeout) {
-        // campaign: become candidate
+      } else if (promotable(gi, p) &&
+                 pr.election_elapsed >= pr.randomized_timeout) {
+        // campaign: become candidate (only voters are promotable)
         pr.election_elapsed = 0;
         pr.term += 1;
         pr.state = ROLE_CANDIDATE;
@@ -130,10 +144,11 @@ struct Engine {
     // Phase C: election resolution among alive requesters at t_star.
     bool winner_elected = false;
     if (n_req > 0) {
-      // term bump for alive peers below t_star (request receipt).
+      // term bump for alive voters below t_star (request receipt;
+      // campaign() sends requests only to voters).
       for (int p = 0; p < P; ++p) {
         Peer& pr = ps[p];
-        if (!crashed[p] && pr.term < t_star) {
+        if (!crashed[p] && promotable(gi, p) && pr.term < t_star) {
           pr.term = t_star;
           pr.state = ROLE_FOLLOWER;
           pr.vote = 0;
@@ -143,16 +158,15 @@ struct Engine {
           pr.randomized_timeout = timeout_draw(node_key(gi, p), pr.term, lo, hi);
         }
       }
-      // votes: each responder grants the lowest-index eligible candidate.
-      int votes_for[16] = {0};
-      int n_responders = 0;
+      // votes: each responder grants the lowest-index eligible candidate;
+      // tallies are per joint half (win both / lose either, empty wins).
+      int grant_of[16];
+      for (int v = 0; v < P; ++v) grant_of[v] = -1;
       for (int v = 0; v < P; ++v) {
         Peer& pv = ps[v];
-        if (crashed[v] || pv.term != t_star) continue;
-        ++n_responders;
+        if (crashed[v] || !promotable(gi, v) || pv.term != t_star) continue;
         if (pv.vote != 0) {
-          // requesters voted self
-          if (req[v] && ps[v].term == t_star) votes_for[v] += 1;
+          if (req[v] && ps[v].term == t_star) grant_of[v] = v;
           continue;
         }
         for (int c = 0; c < P; ++c) {
@@ -163,21 +177,38 @@ struct Engine {
                ps[c].last_index >= pv.last_index);
           if (up_to_date) {
             pv.vote = c + 1;
-            votes_for[c] += 1;
+            grant_of[v] = c;
             break;
           }
         }
       }
-      const int quorum = P / 2 + 1;
-      const int missing = P - n_responders;
+      auto half = [&](int c, bool use_out, bool& won_h, bool& lost_h) {
+        int n = 0, resp = 0, votes = 0;
+        for (int v = 0; v < P; ++v) {
+          bool in_half = use_out ? outg(gi, v) : vot(gi, v);
+          if (!in_half) continue;
+          ++n;
+          if (!crashed[v] && ps[v].term == t_star) ++resp;
+          if (grant_of[v] == c) ++votes;
+        }
+        int q = n / 2 + 1;
+        int missing = n - resp;
+        won_h = (votes >= q) || (n == 0);
+        lost_h = (votes + missing < q) && (n > 0);
+      };
       int winner = -1;
+      bool lost_of[16] = {false};
       for (int c = 0; c < P; ++c) {
         if (!req[c] || ps[c].term != t_star) continue;
-        if (votes_for[c] >= quorum) winner = c;
+        bool wi, li_, wo, lo_;
+        half(c, false, wi, li_);
+        half(c, true, wo, lo_);
+        if (wi && wo) winner = c;
+        lost_of[c] = li_ || lo_;
       }
       for (int c = 0; c < P; ++c) {
         if (!req[c] || ps[c].term != t_star || c == winner) continue;
-        bool lost = votes_for[c] + missing < quorum;
+        bool lost = lost_of[c];
         if (lost || (winner >= 0 && !crashed[c])) {
           ps[c].state = ROLE_FOLLOWER;
           ps[c].randomized_timeout =
@@ -222,12 +253,12 @@ struct Engine {
     }
     if (!sent) return;
 
-    // sync alive peers with term <= leader's; acks land in the acting
+    // sync alive MEMBERS with term <= leader's; acks land in the acting
     // leader's OWN tracker row.
     auto& row = grp.matched[lidx];
     row[lidx] = lead.last_index;
     for (int p = 0; p < P; ++p) {
-      if (p == lidx || crashed[p]) continue;
+      if (p == lidx || crashed[p] || !member(gi, p)) continue;
       Peer& f = ps[p];
       if (f.term > lead_term) continue;
       bool bumped = f.term < lead_term;
@@ -244,13 +275,23 @@ struct Engine {
       row[p] = f.last_index;
     }
 
-    // quorum commit, gated on the owner's current-term entries
-    // (reference: majority.rs:70-124 + raft_log.rs:487-499).
-    std::vector<int32_t> sorted(row);
-    std::sort(sorted.begin(), sorted.end(), std::greater<int32_t>());
-    int32_t mci = sorted[P / 2];  // quorum-th largest
-    if (mci >= grp.term_start_index[lidx] && mci > lead.commit)
-      lead.commit = mci;
+    // joint quorum commit = min over both majorities, gated on the
+    // owner's current-term entries (reference: majority.rs:70-124,
+    // joint.rs:47-51, raft_log.rs:487-499).
+    auto quorum_of = [&](bool use_out) -> int64_t {
+      std::vector<int32_t> vals;
+      for (int v = 0; v < P; ++v) {
+        bool in_half = use_out ? outg(gi, v) : vot(gi, v);
+        if (in_half) vals.push_back(row[v]);
+      }
+      if (vals.empty()) return INT64_MAX;
+      std::sort(vals.begin(), vals.end(), std::greater<int32_t>());
+      return vals[vals.size() / 2];
+    };
+    int64_t mci = std::min(quorum_of(false), quorum_of(true));
+    if (mci < INT64_MAX && mci >= grp.term_start_index[lidx] &&
+        mci > lead.commit)
+      lead.commit = static_cast<int32_t>(mci);
     for (int p = 0; p < P; ++p) {
       if (p == lidx || crashed[p]) continue;
       if (ps[p].term == lead_term && ps[p].state == ROLE_FOLLOWER &&
@@ -278,6 +319,16 @@ void* mr_create(int32_t n_groups, int32_t n_peers, int32_t election_tick,
 }
 
 void mr_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+// Install config masks ([G*P] uint8 each; null keeps the current value).
+void mr_set_config(void* h, const uint8_t* voter, const uint8_t* outgoing,
+                   const uint8_t* learner) {
+  auto* e = static_cast<Engine*>(h);
+  size_t n = static_cast<size_t>(e->G) * e->P;
+  if (voter) e->voter.assign(voter, voter + n);
+  if (outgoing) e->outgoing.assign(outgoing, outgoing + n);
+  if (learner) e->learner.assign(learner, learner + n);
+}
 
 void mr_step(void* h, const uint8_t* crashed, const int32_t* append_n) {
   static_cast<Engine*>(h)->step(crashed, append_n);

@@ -57,6 +57,9 @@ def load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.mr_set_config.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_uint8)
+        ] * 3
         lib.mr_run.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_uint8),
@@ -90,6 +93,21 @@ class NativeMultiRaft:
         if getattr(self, "handle", None):
             self.lib.mr_destroy(self.handle)
             self.handle = None
+
+    def set_config(self, voter=None, outgoing=None, learner=None) -> None:
+        """Install [G, P] config masks (joint + learner support)."""
+
+        def ptr(a):
+            if a is None:
+                return None
+            a = np.ascontiguousarray(a, dtype=np.uint8)
+            self._cfg_refs.append(a)  # keep alive
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+        self._cfg_refs = []
+        self.lib.mr_set_config(
+            self.handle, ptr(voter), ptr(outgoing), ptr(learner)
+        )
 
     def _bufs(self, crashed, append_n):
         if crashed is None:

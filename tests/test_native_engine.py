@@ -78,3 +78,70 @@ def test_native_run_batch():
     # All groups elected and committed (noop + 1/round in steady state).
     assert (snap["commit"].max(axis=1) > 0).all()
     assert ((snap["state"] == 2).sum(axis=1) == 1).all()
+
+
+def _run_tri_parity(G, P, voters, outgoing, learners, rounds, schedule):
+    """Native vs device parity under joint/learner configs."""
+    from raft_tpu.multiraft import SimConfig
+
+    vm = np.zeros((G, P), np.uint8)
+    om = np.zeros((G, P), np.uint8)
+    lm = np.zeros((G, P), np.uint8)
+    for id in voters:
+        vm[:, id - 1] = 1
+    for id in outgoing:
+        om[:, id - 1] = 1
+    for id in learners:
+        lm[:, id - 1] = 1
+    native = NativeMultiRaft(G, P)
+    native.set_config(vm, om, lm)
+    sim = ClusterSim(
+        SimConfig(n_groups=G, n_peers=P),
+        jnp.asarray(vm.T != 0),
+        jnp.asarray(om.T != 0),
+        jnp.asarray(lm.T != 0),
+    )
+    for r in range(rounds):
+        crashed, append = schedule(r)
+        native.step(crashed, append)
+        sim.run_round(jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32))
+        got = native.snapshot()
+        for f in FIELDS:
+            want = np.asarray(getattr(sim.state, f), dtype=np.int32).T
+            np.testing.assert_array_equal(
+                want, got[f], err_msg=f"round {r} field {f}"
+            )
+
+
+def test_native_joint_config_parity():
+    G, P = 4, 5
+    rng = np.random.RandomState(31)
+    crashed = np.zeros((G, P), bool)
+
+    def schedule(r, rng=rng, crashed=crashed):
+        for g in range(G):
+            if rng.rand() < 0.05:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        return crashed.copy(), rng.randint(0, 2, size=G).astype(np.int64)
+
+    _run_tri_parity(G, P, [1, 2, 3], [3, 4, 5], [], 100, schedule)
+
+
+def test_native_learner_config_parity():
+    G, P = 4, 5
+    rng = np.random.RandomState(32)
+    crashed = np.zeros((G, P), bool)
+
+    def schedule(r, rng=rng, crashed=crashed):
+        for g in range(G):
+            if rng.rand() < 0.05:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        return crashed.copy(), rng.randint(0, 2, size=G).astype(np.int64)
+
+    _run_tri_parity(G, P, [1, 2, 3], [], [4, 5], 100, schedule)
