@@ -136,18 +136,28 @@ def bench_baseline_configs(results, quick):
     from raft_tpu.multiraft.sim import SimConfig
 
     configs = [
-        ("config2: 1k x 3 uniform", 1_000, 3, 1),
-        ("config3: 100k x 5 zipf-ish", 100_000, 5, 1),
-        ("config5: 1M x 3 storm", 1_000_000, 3, 0),
+        ("config2: 1k x 3 uniform", 1_000, 3, "uniform"),
+        ("config3: 100k x 5 zipf", 100_000, 5, "zipf"),
+        ("config5: 1M x 3 storm", 1_000_000, 3, "none"),
     ]
     if quick:
         configs = configs[:1]
     rounds = 50
-    for name, G, P, app in configs:
+    for name, G, P, workload in configs:
         cfg = SimConfig(n_groups=G, n_peers=P)
         st = sim.init_state(cfg)
         crashed = jnp.zeros((P, G), bool)
-        append = jnp.full((G,), app, jnp.int32)
+        if workload == "zipf":
+            # Zipf-skewed per-group append rates (TiKV-style hot regions):
+            # a few groups take most of the write load.
+            import numpy as _np
+
+            rng = _np.random.RandomState(0)
+            append = jnp.asarray(
+                _np.minimum(rng.zipf(1.8, size=G), 8).astype(_np.int32)
+            )
+        else:
+            append = jnp.full((G,), 1 if workload == "uniform" else 0, jnp.int32)
         step = functools.partial(sim.step, cfg)
 
         @functools.partial(jax.jit, donate_argnums=(0,))

@@ -226,3 +226,29 @@ def test_read_only_with_learner():
         assert rs, f"#{i}"
         assert rs[0].index == wri, f"#{i}"
         assert rs[0].request_ctx == wctx, f"#{i}"
+
+
+def test_read_when_quorum_becomes_less():
+    """A pending read resolves when a conf change shrinks the quorum
+    (reference: test_raft.rs:5380-5416)."""
+    from raft_tpu import ConfChange, ConfChangeType, Message
+
+    network = Network.new([None, None])
+    network.send([Message(msg_type=MessageType.MsgHup, from_=1, to=1)])
+    assert network.peers[1].raft_log.committed == 1
+
+    # Read index on the leader.
+    m = Message(msg_type=MessageType.MsgReadIndex, to=1)
+    m.entries = [Entry(data=b"abcdefg")]
+    network.dispatch([m])
+
+    # Broadcast heartbeats; drop the response from peer 2.
+    heartbeats = network.read_messages()
+    network.dispatch(heartbeats)
+    heartbeat_responses = network.read_messages()
+    assert len(heartbeat_responses) == 1
+
+    # Removing peer 2 shrinks the quorum to {1}: the read resolves.
+    cc = ConfChange(change_type=ConfChangeType.RemoveNode, node_id=2)
+    network.peers[1].raft.apply_conf_change(cc.as_v2())
+    assert network.peers[1].raft.read_states
