@@ -61,6 +61,11 @@ struct Group {
   // view, not the latest regime's).
   std::vector<std::vector<int32_t>> matched;  // [P_owner][P_target]
   std::vector<int32_t> term_start_index;      // [P_owner]
+  // Pairwise log-agreement lengths (logs diverge via crashed peers' stale
+  // suffixes; every log is a wholesale-adopted regime log, so agreement is
+  // prefix-shaped): the vote-traffic commit fast-forward's term check is
+  // "m.commit <= agree[receiver][sender]".
+  std::vector<std::vector<int32_t>> agree;  // [P][P]
 };
 
 struct Engine {
@@ -91,6 +96,7 @@ struct Engine {
       grp.peers.resize(P);
       grp.matched.assign(P, std::vector<int32_t>(P, 0));
       grp.term_start_index.assign(P, 0);
+      grp.agree.assign(P, std::vector<int32_t>(P, 0));
       for (int pi = 0; pi < P; ++pi) {
         grp.peers[pi].randomized_timeout =
             timeout_draw(node_key(gi, pi), 0, election_tick, 2 * election_tick);
@@ -144,6 +150,45 @@ struct Engine {
     // Phase C: election resolution among alive requesters at t_star.
     bool winner_elected = false;
     if (n_req > 0) {
+      // Deposed-leader heartbeat interleaving: a live leader's queued
+      // heartbeats reach voters only if its pump position precedes the
+      // first campaigner's, and always reach learners (no vote requests
+      // bump them first).  Heartbeat commit is clamped to
+      // min(matched, committed) (reference: raft.rs:829-839).
+      {
+        int pl = -1;
+        int32_t plt = -1;
+        for (int p = 0; p < P; ++p)
+          if (!crashed[p] && ps[p].state == ROLE_LEADER && ps[p].term > plt) {
+            pl = p;
+            plt = ps[p].term;
+          }
+        if (pl >= 0 && t_star > plt && want_beat[pl]) {
+          int first_req = P;
+          for (int p = 0; p < P; ++p)
+            if (req[p]) { first_req = p; break; }
+          bool hb_first = pl < first_req;
+          for (int p = 0; p < P; ++p) {
+            if (p == pl || crashed[p] || ps[p].term > plt) continue;
+            bool is_learner = lrn(gi, p);
+            if (!(is_learner || (hb_first && promotable(gi, p)))) continue;
+            int32_t hb_val =
+                std::min(grp.matched[pl][p], ps[pl].commit);
+            if (hb_val > ps[p].commit) ps[p].commit = hb_val;
+            if (is_learner) {
+              ps[p].election_elapsed = 0;
+              ps[p].leader_id = pl + 1;
+            }
+          }
+        }
+      }
+      // Candidates contending at t_star are requesters whose PRE-BUMP
+      // term is t_star; lower-term requesters are deposed by the bump and
+      // their stale requests are ignored (m.term < receiver term).
+      bool cand_pre[16];
+      for (int c = 0; c < P; ++c)
+        cand_pre[c] = req[c] && ps[c].term == t_star;
+
       // term bump for alive voters below t_star (request receipt;
       // campaign() sends requests only to voters).
       for (int p = 0; p < P; ++p) {
@@ -166,11 +211,11 @@ struct Engine {
         Peer& pv = ps[v];
         if (crashed[v] || !promotable(gi, v) || pv.term != t_star) continue;
         if (pv.vote != 0) {
-          if (req[v] && ps[v].term == t_star) grant_of[v] = v;
+          if (cand_pre[v]) grant_of[v] = v;
           continue;
         }
         for (int c = 0; c < P; ++c) {
-          if (!req[c] || ps[c].term != t_star) continue;
+          if (!cand_pre[c] || c == v) continue;
           bool up_to_date =
               (ps[c].last_term > pv.last_term) ||
               (ps[c].last_term == pv.last_term &&
@@ -179,6 +224,62 @@ struct Engine {
             pv.vote = c + 1;
             grant_of[v] = c;
             break;
+          }
+        }
+      }
+
+      // Commit fast-forward via vote traffic (maybe_commit_by_vote,
+      // reference: raft.rs:2126-2164; requests carry commit info
+      // raft.rs:1249-1254, reject responses raft.rs:1455-1458).  Logs are
+      // prefix-consistent, so the term check reduces to a bounds check.
+      // Wave 1 (requests, candidate-index order): rejecting non-leader
+      // responders fast-forward from the request's campaign-time commit;
+      // the reject response snapshots the responder's commit at that
+      // moment.  Wave 2 (responses, voter-index order): candidates apply
+      // rejection snapshots until their grant quorum lands.
+      {
+        int32_t req_commit[16];
+        for (int c = 0; c < P; ++c) req_commit[c] = ps[c].commit;
+        int32_t snap[16][16];  // snap[c][v]: responder v's commit in c's
+                               // reject response (-1 = no rejection)
+        for (int c = 0; c < P; ++c)
+          for (int v = 0; v < P; ++v) snap[c][v] = -1;
+        for (int c = 0; c < P; ++c) {
+          if (!cand_pre[c]) continue;
+          for (int v = 0; v < P; ++v) {
+            if (v == c) continue;
+            Peer& pv = ps[v];
+            if (crashed[v] || !promotable(gi, v) || pv.term != t_star)
+              continue;
+            if (grant_of[v] == c) continue;  // granted: no commit info
+            snap[c][v] = pv.commit;
+            if (pv.state != ROLE_LEADER && req_commit[c] > pv.commit &&
+                req_commit[c] <= grp.agree[v][c])
+              pv.commit = req_commit[c];
+          }
+        }
+        for (int c = 0; c < P; ++c) {
+          if (!cand_pre[c]) continue;
+          int cnt_i = vot(gi, c) ? 1 : 0;
+          int cnt_o = outg(gi, c) ? 1 : 0;
+          int n_i = 0, n_o = 0;
+          for (int v = 0; v < P; ++v) {
+            if (vot(gi, v)) ++n_i;
+            if (outg(gi, v)) ++n_o;
+          }
+          int q_i = n_i / 2 + 1, q_o = n_o / 2 + 1;
+          for (int v = 0; v < P; ++v) {
+            bool won_before = ((cnt_i >= q_i) || n_i == 0) &&
+                              ((cnt_o >= q_o) || n_o == 0);
+            if (snap[c][v] >= 0 && !won_before &&
+                snap[c][v] <= grp.agree[c][v] &&
+                snap[c][v] > ps[c].commit)
+              ps[c].commit = snap[c][v];
+            if (grant_of[v] == c && v != c) {
+              // v == c is the self-vote, already in the initial counts
+              if (vot(gi, v)) ++cnt_i;
+              if (outg(gi, v)) ++cnt_o;
+            }
           }
         }
       }
@@ -199,7 +300,7 @@ struct Engine {
       int winner = -1;
       bool lost_of[16] = {false};
       for (int c = 0; c < P; ++c) {
-        if (!req[c] || ps[c].term != t_star) continue;
+        if (!cand_pre[c]) continue;
         bool wi, li_, wo, lo_;
         half(c, false, wi, li_);
         half(c, true, wo, lo_);
@@ -207,7 +308,7 @@ struct Engine {
         lost_of[c] = li_ || lo_;
       }
       for (int c = 0; c < P; ++c) {
-        if (!req[c] || ps[c].term != t_star || c == winner) continue;
+        if (!cand_pre[c] || c == winner) continue;
         bool lost = lost_of[c];
         if (lost || (winner >= 0 && !crashed[c])) {
           ps[c].state = ROLE_FOLLOWER;
@@ -257,10 +358,13 @@ struct Engine {
     // leader's OWN tracker row.
     auto& row = grp.matched[lidx];
     row[lidx] = lead.last_index;
+    bool in_s[16] = {false};
+    in_s[lidx] = true;
     for (int p = 0; p < P; ++p) {
       if (p == lidx || crashed[p] || !member(gi, p)) continue;
       Peer& f = ps[p];
       if (f.term > lead_term) continue;
+      in_s[p] = true;
       bool bumped = f.term < lead_term;
       f.term = lead_term;
       f.state = ROLE_FOLLOWER;
@@ -273,6 +377,23 @@ struct Engine {
       f.last_index = lead.last_index;
       f.last_term = lead.last_term;
       row[p] = f.last_index;
+    }
+
+    // log-agreement update: the sync set now holds exactly the leader's
+    // log.
+    {
+      int32_t lead_row[16];
+      for (int b = 0; b < P; ++b) lead_row[b] = grp.agree[lidx][b];
+      for (int a = 0; a < P; ++a)
+        for (int b = 0; b < P; ++b) {
+          if (a == b) continue;
+          if (in_s[a] && in_s[b])
+            grp.agree[a][b] = lead.last_index;
+          else if (in_s[a])
+            grp.agree[a][b] = lead_row[b];
+          else if (in_s[b])
+            grp.agree[a][b] = lead_row[a];
+        }
     }
 
     // joint quorum commit = min over both majorities, gated on the
@@ -295,8 +416,8 @@ struct Engine {
     for (int p = 0; p < P; ++p) {
       if (p == lidx || crashed[p]) continue;
       if (ps[p].term == lead_term && ps[p].state == ROLE_FOLLOWER &&
-          ps[p].leader_id == lidx + 1) {
-        ps[p].commit = lead.commit;
+          ps[p].leader_id == lidx + 1 && lead.commit > ps[p].commit) {
+        ps[p].commit = lead.commit;  // commit_to never decreases
       }
     }
   }
@@ -355,6 +476,31 @@ void mr_read_state(void* h, int32_t* term, int32_t* state, int32_t* commit,
       ++i;
     }
   }
+}
+
+// Debug: dump the remaining per-peer fields [G, P] each.
+void mr_read_state2(void* h, int32_t* vote, int32_t* ee, int32_t* hb,
+                    int32_t* rt, int32_t* leader_id) {
+  auto* e = static_cast<Engine*>(h);
+  size_t i = 0;
+  for (auto& g : e->groups)
+    for (auto& p : g.peers) {
+      vote[i] = p.vote;
+      ee[i] = p.election_elapsed;
+      hb[i] = p.heartbeat_elapsed;
+      rt[i] = p.randomized_timeout;
+      leader_id[i] = p.leader_id;
+      ++i;
+    }
+}
+
+// Debug: dump agree planes [G, P, P].
+void mr_read_agree(void* h, int32_t* out) {
+  auto* e = static_cast<Engine*>(h);
+  size_t i = 0;
+  for (auto& g : e->groups)
+    for (int a = 0; a < e->P; ++a)
+      for (int b = 0; b < e->P; ++b) out[i++] = g.agree[a][b];
 }
 
 }  // extern "C"

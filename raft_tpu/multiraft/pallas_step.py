@@ -206,6 +206,22 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
         matched = jnp.where(
             is_leader[:, None, :], new_row[None, :, :], st.matched
         )
+        # Pairwise log-agreement update, applied once for the whole horizon
+        # (idempotent per round: the sync set is constant while steady, and
+        # only the final leader last_index matters).
+        member = st.voter_mask | st.learner_mask
+        in_s = (member & ~crashed) | is_leader
+        lead_last = jnp.max(jnp.where(is_leader, li, 0), axis=0)  # [G]
+        lead_row = jnp.sum(st.agree * f[:, None, :], axis=0)  # [P, G]
+        agree = jnp.where(
+            in_s[:, None, :] & in_s[None, :, :],
+            lead_last[None, None, :],
+            jnp.where(
+                in_s[:, None, :],
+                lead_row[None, :, :],
+                jnp.where(in_s[None, :, :], lead_row[:, None, :], st.agree),
+            ),
+        )
         return st._replace(
             election_elapsed=ee,
             heartbeat_elapsed=hb,
@@ -213,6 +229,7 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
             last_term=lt,
             matched=matched,
             commit=commit,
+            agree=agree,
         )
 
     return fn

@@ -45,8 +45,8 @@ def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
         term=pg, state=pg, vote=pg, leader_id=pg,
         election_elapsed=pg, heartbeat_elapsed=pg, randomized_timeout=pg,
         last_index=pg, last_term=pg, commit=pg,
-        matched=ppg, term_start_index=pg, voter_mask=pg, outgoing_mask=pg,
-        learner_mask=pg,
+        matched=ppg, term_start_index=pg, agree=ppg, voter_mask=pg,
+        outgoing_mask=pg, learner_mask=pg,
     )
 
 

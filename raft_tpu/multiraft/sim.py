@@ -27,10 +27,18 @@ Protocol scope of v1 (what BASELINE configs 2/3/5 need):
   * fault injection by per-round crash (isolation) masks — crashed peers
     keep ticking and campaigning but exchange no messages.
   Not modeled on device yet (host path handles them): pre-vote,
-  check-quorum, joint reconfig mid-flight, snapshots, divergent log tails
-  (impossible under instant in-round replication: within a round every
-  append reaches every alive peer, so logs stay prefixes of each other and
-  the maybe_append conflict scan stays host-side — SURVEY.md §7 hard-3).
+  check-quorum (incl. leases), snapshots.
+
+Log model: each peer's log is summarized by (last_index, last_term) plus
+the pairwise agreement plane `agree[a, b]` (common-prefix length).  Logs DO
+diverge — a crashed peer keeps a stale uncommitted suffix while a new
+regime canonizes other entries — but replication is wholesale adoption of
+the leader's log, so the live log-shapes form a tree and pairwise
+agreement stays prefix-shaped and maintainable without entry contents
+(the per-entry conflict scan itself stays host-side — SURVEY.md §7
+hard-3).  Commit fast-forward via vote traffic (maybe_commit_by_vote)
+and deposed-leader heartbeat interleavings are modeled exactly; see
+tests/test_sim_fuzz.py for the schedules that originally exposed them.
 """
 
 from __future__ import annotations
@@ -84,6 +92,16 @@ class SimState(NamedTuple):
     # term-start, not the newer regime's (found by the storm parity test).
     matched: jnp.ndarray  # [P_owner, P_target, G] Progress.matched views
     term_start_index: jnp.ndarray  # [P, G] owner's noop index
+    # Pairwise log-agreement lengths: agree[a, b, g] = length of the common
+    # prefix of peer a's and b's logs.  Logs CAN diverge (a crashed peer
+    # keeps a stale uncommitted suffix while a new regime canonizes other
+    # entries), but every log is a wholesale-adopted regime log, so the
+    # regime logs form a tree and pairwise agreement is prefix-shaped.
+    # This is what makes maybe_commit_by_vote's "term(m.commit) ==
+    # m.commit_term" check computable from cursors: the sender committed
+    # m.commit, so the receiver's entry there matches iff
+    # m.commit <= agree[receiver, sender] (index+term identify entries).
+    agree: jnp.ndarray  # [P, P, G]
     voter_mask: jnp.ndarray  # [P, G] incoming majority config
     # Outgoing majority for joint consensus (reference: joint.rs:12-15):
     # all-False = not joint; decisions then need BOTH majorities (BASELINE
@@ -141,6 +159,7 @@ def init_state(
         commit=zeros(),
         matched=jnp.zeros((P, P, G), jnp.int32),
         term_start_index=jnp.zeros((P, G), jnp.int32),
+        agree=jnp.zeros((P, P, G), jnp.int32),
         voter_mask=voter_mask,
         outgoing_mask=outgoing_mask,
         learner_mask=learner_mask,
@@ -234,9 +253,47 @@ def step(
     req = want_campaign & alive
 
     def election(args):
-        (term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts) = args
+        (
+            term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts,
+            commit,
+        ) = args
         any_req = jnp.any(req, axis=0)  # [G]
         t_star = jnp.max(jnp.where(req, term, 0), axis=0)  # [G]
+        p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
+
+        # --- deposed-leader heartbeat interleaving.  If a live leader beat
+        # this round but a higher-term campaign deposes it, its heartbeats
+        # were already queued: they reach voters only if the leader's pump
+        # position precedes the first campaigner's (FIFO by peer index), and
+        # always reach learners (learners get no vote requests, so nothing
+        # bumps them first).  Heartbeats carry commit clamped to
+        # min(matched, committed) (reference: raft.rs:829-839).
+        prev_leader = (state == ROLE_LEADER) & alive
+        prev_has = jnp.any(prev_leader, axis=0)
+        prev_lt = jnp.max(jnp.where(prev_leader, term, -1), axis=0)
+        prev_acting = prev_leader & (term == prev_lt)
+        prev_first = jnp.min(jnp.where(prev_acting, p_idx, P), axis=0)
+        prev_is_acting = (p_idx == prev_first) & prev_has
+        beat = jnp.any(want_heartbeat & prev_is_acting, axis=0)
+        deposed = prev_has & (t_star > prev_lt) & any_req
+        first_req = jnp.min(jnp.where(req, p_idx, P), axis=0)
+        hb_first = prev_first < first_req
+        prev_f = prev_is_acting.astype(jnp.int32)
+        prev_row = jnp.sum(matched * prev_f[:, None, :], axis=0)  # [P, G]
+        prev_commit = jnp.max(jnp.where(prev_is_acting, commit, 0), axis=0)
+        hb_val = jnp.minimum(prev_row, prev_commit[None, :])
+        apply_v = (
+            deposed & beat & hb_first & alive & promotable
+            & (term <= prev_lt) & ~prev_is_acting
+        )
+        apply_l = (
+            deposed & beat & alive & st.learner_mask & (term <= prev_lt)
+        )
+        commit = jnp.where(
+            apply_v | apply_l, jnp.maximum(commit, hb_val), commit
+        )
+        ee = jnp.where(apply_l, 0, ee)
+        leader_id = jnp.where(apply_l, prev_first + 1, leader_id)
 
         # Receiving a higher-term request makes any alive VOTER a follower
         # at that term with vote cleared (reference: raft.rs:1284-1348;
@@ -300,6 +357,61 @@ def step(
 
         winner_exists = jnp.any(won, axis=0)  # [G]
 
+        # --- commit fast-forward via vote traffic (reference:
+        # maybe_commit_by_vote raft.rs:2126-2164; requests carry commit info
+        # raft.rs:1249-1254, reject responses raft.rs:1455-1458).  The sim's
+        # logs are prefix-consistent, so the receiver's "term(m.commit) ==
+        # m.commit_term" check reduces to "m.commit <= receiver.last_index".
+        # Scalar pump ordering: requests processed in candidate-index order
+        # (voter-side snapshots accumulate), responses in voter-index order
+        # (a winner stops applying rejections once its grant quorum lands,
+        # raft.rs:2184-2190 + step_leader ignoring vote responses).
+        n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+        n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+        q_i = n_i // 2 + 1
+        q_o = n_o // 2 + 1
+        commit_run = commit  # running voter commits, wave-1 order
+        cand_ff = jnp.zeros_like(commit)  # candidate-side fast-forwards
+        for ci in range(P):
+            c_active = cand[ci]  # [G]
+            c_req_commit = commit[ci]  # snapshotted at campaign time
+            grants_ci = granted_v[ci]  # [P_v, G]
+            rej_ci = (
+                responder & ~grants_ci & (p_idx != ci) & c_active[None, :]
+            )
+            # agree[ci] row: by symmetry, both "receiver v holds ci's
+            # committed entry" and "ci holds v's committed entry" are
+            # index <= agree[ci, v].
+            agree_ci = st.agree[ci]  # [P_v, G]
+            # candidate-side: rejections apply until the grant quorum lands
+            cnt_i = (c_active & st.voter_mask[ci]).astype(jnp.int32)
+            cnt_o = (c_active & st.outgoing_mask[ci]).astype(jnp.int32)
+            ff = jnp.zeros((G,), jnp.int32)
+            for v in range(P):
+                won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
+                    (cnt_o >= q_o) | (n_o == 0)
+                )
+                snap = commit_run[v]
+                ok = rej_ci[v] & ~won_before & (snap <= agree_ci[v])
+                ff = jnp.where(ok, jnp.maximum(ff, snap), ff)
+                cnt_i = cnt_i + (grants_ci[v] & st.voter_mask[v]).astype(
+                    jnp.int32
+                )
+                cnt_o = cnt_o + (grants_ci[v] & st.outgoing_mask[v]).astype(
+                    jnp.int32
+                )
+            cand_ff = cand_ff.at[ci].set(jnp.maximum(cand_ff[ci], ff))
+            # voter-side: rejecting non-leader voters fast-forward from the
+            # request's commit (leaders skip, raft.rs:2131).
+            vs_apply = (
+                rej_ci
+                & (state_c != ROLE_LEADER)
+                & (c_req_commit[None, :] > commit_run)
+                & (c_req_commit[None, :] <= agree_ci)
+            )
+            commit_run = jnp.where(vs_apply, c_req_commit[None, :], commit_run)
+        commit_c = jnp.maximum(commit_run, cand_ff)
+
         # Record granted votes (reference: raft.rs:1445-1449).
         vote_c = jnp.where(grant_to >= 0, grant_to + 1, vote_c)
 
@@ -325,19 +437,23 @@ def step(
         ts_n = jnp.where(won, li_n, ts)
         return (
             term_c, state_c, vote_c, leader_c, ee_c, hb_c, rt_c,
-            li_n, lt_n, matched_n, ts_n, winner_exists,
+            li_n, lt_n, matched_n, ts_n, commit_c, winner_exists,
         )
 
     def no_election(args):
-        (term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts) = args
+        (
+            term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts,
+            commit,
+        ) = args
         return (
             term, state, vote, leader_id, ee, hb, rt, li, lt, matched, ts,
-            jnp.zeros((G,), bool),
+            commit, jnp.zeros((G,), bool),
         )
 
     (
         term, state, vote, leader_id, ee, hb, rt,
-        new_last_index, new_last_term, matched, term_start, winner_exists,
+        new_last_index, new_last_term, matched, term_start, commit_c,
+        winner_exists,
     ) = jax.lax.cond(
         jnp.any(req),
         election,
@@ -345,6 +461,7 @@ def step(
         (
             term, state, vote, leader_id, ee, hb, rt,
             st.last_index, st.last_term, st.matched, st.term_start_index,
+            st.commit,
         ),
     )
 
@@ -393,10 +510,24 @@ def step(
     new_last_index = jnp.where(sync, lead_last, new_last_index)
     new_last_term = jnp.where(sync, lead_last_term, new_last_term)
 
-    # The acting leader's OWN tracker row: acks from every synced peer + its
-    # own persisted tail.  Other owners' rows stay frozen (they are what a
-    # stale leader resumes with — matching the scalar per-peer trackers).
+    # Pairwise log agreement: every peer in the sync set (incl. the leader)
+    # now holds exactly the leader's log, so agreement within the set is the
+    # leader's last index and agreement with outsiders is the leader's
+    # agreement with them (log adoption is wholesale).
     acting_f = is_acting_leader.astype(jnp.int32)  # [P, G]
+    in_s = sync | is_acting_leader  # [P, G]
+    agree_lead_row = jnp.sum(
+        st.agree * acting_f[:, None, :], axis=0
+    )  # [P, G]: agree[l, b]
+    agree = jnp.where(
+        in_s[:, None, :] & in_s[None, :, :],
+        lead_last[None, None, :],
+        jnp.where(
+            in_s[:, None, :],
+            agree_lead_row[None, :, :],
+            jnp.where(in_s[None, :, :], agree_lead_row[:, None, :], st.agree),
+        ),
+    )
     acting_row = jnp.sum(matched * acting_f[:, None, :], axis=0)  # [P_t, G]
     acting_row = jnp.where(sync | is_acting_leader, new_last_index, acting_row)
     matched = jnp.where(
@@ -415,13 +546,15 @@ def step(
         _quorum_index(acting_row, st.outgoing_mask),
     )
     commit_ok = has_leader & (mci >= ts_acting) & (mci < kernels.INF)
-    lead_commit_old = jnp.max(jnp.where(is_acting_leader, st.commit, 0), axis=0)
+    lead_commit_old = jnp.max(jnp.where(is_acting_leader, commit_c, 0), axis=0)
     lead_commit = jnp.where(
         commit_ok, jnp.maximum(lead_commit_old, mci), lead_commit_old
     )
-    commit = jnp.where(is_acting_leader, lead_commit, st.commit)
-    # Synced followers learn min(leader commit, their last) = leader commit.
-    commit = jnp.where(sync, lead_commit, commit)
+    commit = jnp.where(is_acting_leader, lead_commit, commit_c)
+    # Synced followers learn the leader's commit; commit_to never decreases
+    # (reference: raft_log.rs:286-300), so vote-traffic fast-forwards that
+    # outran a stale leader are kept.
+    commit = jnp.where(sync, jnp.maximum(commit, lead_commit), commit)
 
     return SimState(
         term=term_d,
@@ -436,6 +569,7 @@ def step(
         commit=commit,
         matched=matched,
         term_start_index=term_start,
+        agree=agree,
         voter_mask=st.voter_mask,
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,

@@ -1,0 +1,104 @@
+"""Tri-backend fuzz regression: seeds that historically exposed divergences
+(per-owner tracker reuse, commit fast-forward via vote traffic under log
+divergence, pre-bump candidacy) plus fresh storm schedules, across plain,
+joint, and learner configurations."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
+from raft_tpu.multiraft.native import NativeMultiRaft
+
+FIELDS = ("term", "state", "commit", "last_index", "last_term")
+
+
+def run_fuzz(seed, G, P, rounds, joint=False, learners=False):
+    kwargs = {}
+    vm = om = lm = None
+    vm_gp = om_gp = lm_gp = None
+    if joint:
+        voters, outgoing = [1, 2, 3], [3, 4, 5]
+        kwargs = dict(voters=voters, voters_outgoing=outgoing)
+        vm_np = np.zeros((P, G), bool)
+        om_np = np.zeros((P, G), bool)
+        for id in voters:
+            vm_np[id - 1] = True
+        for id in outgoing:
+            om_np[id - 1] = True
+        vm, om = jnp.asarray(vm_np), jnp.asarray(om_np)
+        vm_gp = np.ascontiguousarray(vm_np.T).astype(np.uint8)
+        om_gp = np.ascontiguousarray(om_np.T).astype(np.uint8)
+    elif learners:
+        voters, lrn = list(range(1, P)), [P]
+        kwargs = dict(voters=voters, learners=lrn)
+        vm_np = np.zeros((P, G), bool)
+        lm_np = np.zeros((P, G), bool)
+        for id in voters:
+            vm_np[id - 1] = True
+        for id in lrn:
+            lm_np[id - 1] = True
+        vm, lm = jnp.asarray(vm_np), jnp.asarray(lm_np)
+        vm_gp = np.ascontiguousarray(vm_np.T).astype(np.uint8)
+        om_gp = np.zeros((G, P), np.uint8)
+        lm_gp = np.ascontiguousarray(lm_np.T).astype(np.uint8)
+
+    scalar = ScalarCluster(G, P, **kwargs)
+    sim = ClusterSim(SimConfig(n_groups=G, n_peers=P), vm, om, lm)
+    native = NativeMultiRaft(G, P)
+    if vm_gp is not None:
+        native.set_config(vm_gp, om_gp, lm_gp)
+    rng = np.random.RandomState(seed)
+    crashed = np.zeros((G, P), bool)
+    for r in range(rounds):
+        for g in range(G):
+            roll = rng.rand()
+            if roll < 0.08:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            elif roll < 0.12:
+                snap = scalar.snapshot()
+                leaders = np.where(snap["state"][g] == 2)[0]
+                if len(leaders):
+                    crashed[g, leaders[0]] = True
+            elif roll < 0.14:
+                crashed[g, :] = False  # mass recovery
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        append = rng.randint(0, 3, size=G).astype(np.int64)
+        scalar.round(crashed, append)
+        sim.run_round(
+            jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32)
+        )
+        native.step(crashed, append)
+        want = scalar.snapshot()
+        nat = native.snapshot()
+        for f in FIELDS:
+            dev = np.asarray(getattr(sim.state, f)).T
+            assert np.array_equal(want[f], dev), (
+                f"seed {seed} round {r}: DEVICE {f}"
+            )
+            assert np.array_equal(want[f].astype(np.int32), nat[f]), (
+                f"seed {seed} round {r}: NATIVE {f}"
+            )
+
+
+def test_fuzz_regression_commit_by_vote():
+    # seed 101 historically: candidate commit fast-forward via rejections
+    run_fuzz(101, 3, 5, 160)
+
+
+def test_fuzz_regression_prebump_candidacy():
+    # seed 102 historically: stale lower-term requester treated as candidate
+    run_fuzz(102, 3, 5, 160)
+
+
+def test_fuzz_regression_mixed():
+    run_fuzz(12, 4, 3, 160)
+    run_fuzz(209, 3, 5, 140, joint=True)
+
+
+def test_fuzz_fresh_seeds():
+    run_fuzz(7, 4, 3, 140)
+    run_fuzz(108, 3, 5, 140)
+    run_fuzz(205, 3, 5, 120, joint=True)
+    run_fuzz(307, 3, 5, 120, learners=True)
