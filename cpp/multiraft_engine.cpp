@@ -400,14 +400,15 @@ struct Engine {
     // owner's current-term entries (reference: majority.rs:70-124,
     // joint.rs:47-51, raft_log.rs:487-499).
     auto quorum_of = [&](bool use_out) -> int64_t {
-      std::vector<int32_t> vals;
+      int32_t vals[16];
+      int n = 0;
       for (int v = 0; v < P; ++v) {
         bool in_half = use_out ? outg(gi, v) : vot(gi, v);
-        if (in_half) vals.push_back(row[v]);
+        if (in_half) vals[n++] = row[v];
       }
-      if (vals.empty()) return INT64_MAX;
-      std::sort(vals.begin(), vals.end(), std::greater<int32_t>());
-      return vals[vals.size() / 2];
+      if (n == 0) return INT64_MAX;
+      std::sort(vals, vals + n, std::greater<int32_t>());
+      return vals[n / 2];
     };
     int64_t mci = std::min(quorum_of(false), quorum_of(true));
     if (mci < INT64_MAX && mci >= grp.term_start_index[lidx] &&
