@@ -63,18 +63,21 @@ def bench_device() -> float:
     state = multi_round(state)
     jax.block_until_ready(state)
 
-    t0 = time.perf_counter()
-    for _ in range(SCANS):
-        state = multi_round(state)
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+    # Shared-TPU tunnel timing is noisy: report the best of three passes.
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(SCANS):
+            state = multi_round(state)
+        jax.block_until_ready(state)
+        best_dt = min(best_dt, time.perf_counter() - t0)
 
     rounds = (ROUNDS_PER_SCAN // K) * K * SCANS
     ticks = G * rounds
     # Sanity: the protocol is actually running (leaders + commits advance).
     commit_min = int(jnp.min(jnp.max(state.commit, axis=0)))
     assert commit_min > 0, "bench sanity: no commits on device"
-    return ticks / dt
+    return ticks / best_dt
 
 
 def bench_scalar_anchor() -> float:
@@ -84,10 +87,12 @@ def bench_scalar_anchor() -> float:
     append = np.ones((ANCHOR_GROUPS,), dtype=np.int32)
     # Let elections settle before timing (same steady state as the device).
     engine.run(25, None, append)
-    t0 = time.perf_counter()
-    engine.run(ANCHOR_ROUNDS, None, append)
-    dt = time.perf_counter() - t0
-    return ANCHOR_GROUPS * ANCHOR_ROUNDS / dt
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        engine.run(ANCHOR_ROUNDS, None, append)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    return ANCHOR_GROUPS * ANCHOR_ROUNDS / best_dt
 
 
 def main() -> None:
