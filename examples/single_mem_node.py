@@ -31,7 +31,7 @@ def main() -> None:
     node = RawNode(cfg, storage)
 
     # The proposal channel: (key, value) pairs the client wants stored.
-    proposals: "queue.Queue[tuple[u8, str]]" = queue.Queue()
+    proposals = queue.Queue()  # (key, value) pairs
     kv = {}
 
     # A client that sends one proposal and waits for it to apply.

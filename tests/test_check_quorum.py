@@ -1,0 +1,97 @@
+"""Check-quorum cluster scenarios: leases rejecting votes, leader
+superseding, non-promotable voters (ported behaviors from reference:
+test_raft.rs:1886-2086)."""
+
+from raft_tpu import ConfChange, ConfChangeType, MessageType, StateRole
+
+from test_util import new_message, new_test_raft
+
+
+def three_with_check_quorum():
+    a = new_test_raft(1, [1, 2, 3], 10, 1)
+    b = new_test_raft(2, [1, 2, 3], 10, 1)
+    c = new_test_raft(3, [1, 2, 3], 10, 1)
+    for x in (a, b, c):
+        x.raft.check_quorum = True
+    from raft_tpu.harness import Network
+
+    return Network.new([a, b, c])
+
+
+def test_leader_superseding_with_check_quorum():
+    """A candidate can't supersede the leader while a quorum holds the
+    lease; it can once the lease lapses (reference: test_raft.rs:1886-1925)."""
+    nt = three_with_check_quorum()
+    b_et = nt.peers[2].raft.election_timeout
+    nt.peers[2].raft.set_randomized_election_timeout(b_et + 1)
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[3].raft.state == StateRole.Follower
+
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    # b rejects c's vote: its election_elapsed is within the lease.
+    assert nt.peers[3].raft.state == StateRole.Candidate
+
+    # let b's lease lapse
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    assert nt.peers[3].raft.state == StateRole.Leader
+
+
+def test_leader_election_with_check_quorum():
+    """reference: test_raft.rs:1927-1987"""
+    nt = three_with_check_quorum()
+    a_et = nt.peers[1].raft.election_timeout
+    b_et = nt.peers[2].raft.election_timeout
+    nt.peers[1].raft.set_randomized_election_timeout(a_et + 1)
+    nt.peers[2].raft.set_randomized_election_timeout(b_et + 2)
+
+    # Immediately after creation, votes are cast regardless of the lease.
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[3].raft.state == StateRole.Follower
+
+    # Re-pin timeouts (state changes redraw them), lapse both leases, and
+    # node 3 can now be elected.
+    a_et = nt.peers[1].raft.election_timeout
+    b_et = nt.peers[2].raft.election_timeout
+    nt.peers[1].raft.set_randomized_election_timeout(a_et + 1)
+    nt.peers[2].raft.set_randomized_election_timeout(b_et + 2)
+    for _ in range(a_et):
+        nt.peers[1].raft.tick()
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(3, 3, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[3].raft.state == StateRole.Leader
+
+
+def test_non_promotable_voter_with_check_quorum():
+    """A removed (non-promotable) node never campaigns but still follows
+    (reference: test_raft.rs:2043-2081)."""
+    from raft_tpu.harness import Network
+
+    a = new_test_raft(1, [1, 2], 10, 1)
+    b = new_test_raft(2, [1], 10, 1)
+    a.raft.check_quorum = True
+    b.raft.check_quorum = True
+    nt = Network.new([a, b])
+
+    b_et = nt.peers[2].raft.election_timeout
+    nt.peers[2].raft.set_randomized_election_timeout(b_et + 1)
+    # make 2 non-promotable (it's not in its own config)
+    cc = ConfChange(change_type=ConfChangeType.RemoveNode, node_id=2)
+    nt.peers[2].raft.apply_conf_change(cc.as_v2())
+    assert not nt.peers[2].raft.promotable
+
+    for _ in range(b_et):
+        nt.peers[2].raft.tick()
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    assert nt.peers[1].raft.state == StateRole.Leader
+    assert nt.peers[2].raft.state == StateRole.Follower
+    assert nt.peers[2].raft.leader_id == 1
