@@ -21,7 +21,7 @@ message out, byte-identical re-encoding).  Layout per type:
 from __future__ import annotations
 
 import struct
-from typing import List, Tuple
+from typing import List
 
 from .eraftpb import (
     ConfState,

@@ -7,7 +7,7 @@ also accepts per-group *arrays* of tick bounds (see raft_tpu.multiraft).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .errors import ConfigInvalid
 from .read_only_option import ReadOnlyOption

@@ -14,7 +14,6 @@ For the per-group HardState view (what the reference would fsync), use
 
 from __future__ import annotations
 
-import io
 import os
 import tempfile
 from typing import Dict
@@ -22,7 +21,7 @@ from typing import Dict
 import numpy as np
 import jax.numpy as jnp
 
-from .sim import SimConfig, SimState
+from .sim import SimState
 
 _FORMAT_VERSION = 1
 

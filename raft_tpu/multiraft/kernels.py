@@ -24,7 +24,7 @@ overflow), so no x64 dependency.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -27,15 +27,13 @@ which is exactly the lockstep schedule ScalarCluster/bench drive).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import sim as sim_mod
-from .kernels import INF, ROLE_LEADER
+from .kernels import ROLE_LEADER
 from .sim import SimConfig, SimState
 
 BLOCK = 8192

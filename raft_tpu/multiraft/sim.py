@@ -44,7 +44,7 @@ tests/test_sim_fuzz.py for the schedules that originally exposed them.
 from __future__ import annotations
 
 import functools
-from typing import List, NamedTuple, Optional, Tuple
+from typing import List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
