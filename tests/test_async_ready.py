@@ -1,0 +1,345 @@
+"""Async-ready protocol deep tests: numbered readies, partial persistence,
+commit forwarding by persist order (ported behaviors from reference:
+test_raw_node.rs:1074-1685)."""
+
+from raft_tpu import (
+    Entry,
+    HardState,
+    MemStorage,
+    Message,
+    MessageType,
+    ProgressState,
+    RawNode,
+)
+
+from test_util import (
+    new_hard_state,
+    new_message,
+    new_snapshot,
+    new_test_config,
+    new_test_raw_node,
+)
+
+
+def test_async_ready_leader():
+    """reference: test_raw_node.rs:1074-1252"""
+    s = MemStorage()
+    with s.wl() as core:
+        core.apply_snapshot(new_snapshot(1, 1, [1, 2, 3]))
+    node = new_test_raw_node(1, [1, 2, 3], 10, 1, s)
+    node.raft.become_candidate()
+    node.raft.become_leader()
+    rd = node.ready()
+    assert rd.ss is not None and rd.ss.leader_id == node.raft.leader_id
+    with s.wl() as core:
+        core.append(rd.entries)
+    node.advance(rd)
+
+    assert node.raft.term == 2
+    first_index = node.raft.raft_log.last_index()
+    data = b"hello world!"
+
+    # Node 2 replicates; node 3 stays silent.
+    node.raft.prs.get_mut(2).matched = 1
+    node.raft.prs.get_mut(2).become_replicate()
+    for i in range(10):
+        for _ in range(10):
+            node.propose(b"", data)
+        rd = node.ready()
+        assert rd.number == i + 2
+        entries = list(rd.entries)
+        assert entries[0].index == first_index + i * 10 + 1
+        assert entries[-1].index == first_index + i * 10 + 10
+        # Leader messages are immediate.
+        assert not rd.persisted_messages()
+        for msg in rd.take_messages():
+            assert msg.msg_type == MessageType.MsgAppend
+        with s.wl() as core:
+            core.append(entries)
+        node.advance_append_async(rd)
+
+    # Unpersisted readies numbered [2, 11]; persist through number 4.
+    node.on_persist_ready(4)
+    assert not node.has_ready()
+
+    # Node 2 acks everything: commit is capped by OUR persisted index.
+    ar = new_message(2, 1, MessageType.MsgAppendResponse)
+    ar.term = 2
+    ar.index = first_index + 100
+    node.step(ar)
+
+    rd = node.ready()
+    assert rd.hs == new_hard_state(2, 1, first_index + 30)
+    assert rd.committed_entries()[0].index == first_index
+    assert rd.committed_entries()[-1].index == first_index + 30
+    assert rd.messages()
+    with s.wl() as core:
+        core.set_hardstate(rd.hs.clone())
+    node.advance_append_async(rd)
+
+    # More persistence forwards commit further.
+    node.on_persist_ready(8)
+    rd = node.ready()
+    assert rd.hs == new_hard_state(2, 1, first_index + 70)
+    assert rd.committed_entries()[0].index == first_index + 31
+    assert rd.committed_entries()[-1].index == first_index + 70
+    assert rd.messages()
+    assert not rd.persisted_messages()
+    with s.wl() as core:
+        core.set_hardstate(rd.hs.clone())
+
+    # Persisting the last ready forwards commit to the acked maximum.
+    light_rd = node.advance_append(rd)
+    assert light_rd.commit_index == first_index + 100
+    assert light_rd.committed_entries[0].index == first_index + 71
+    assert light_rd.committed_entries[-1].index == first_index + 100
+    assert light_rd.messages
+
+    # Two followers ack entries the leader has NOT persisted yet.
+    first_index += 100
+    for _ in range(10):
+        node.propose(b"", data)
+    rd = node.ready()
+    assert rd.number == 14
+    entries = list(rd.entries)
+    assert entries[0].index == first_index + 1
+    assert entries[-1].index == first_index + 10
+    for msg in rd.take_messages():
+        assert msg.msg_type == MessageType.MsgAppend
+    with s.wl() as core:
+        core.append(entries)
+    node.advance_append_async(rd)
+
+    ar = new_message(2, 1, MessageType.MsgAppendResponse)
+    ar.term = 2
+    ar.index = first_index + 9
+    node.step(ar)
+    ar = new_message(3, 1, MessageType.MsgAppendResponse)
+    ar.term = 2
+    ar.index = first_index + 10
+    node.step(ar)
+
+    rd = node.ready()
+    # Commit stops at first_index + 9 (a quorum of 2,3 acked +10 but we only
+    # persisted through +9... actually: 2 acked +9, 3 acked +10; quorum
+    # median is +9).
+    assert rd.hs == new_hard_state(2, 1, first_index + 9)
+    assert not rd.entries
+    assert not rd.committed_entries()
+    for msg in rd.take_messages():
+        assert msg.msg_type == MessageType.MsgAppend
+        assert msg.commit == first_index + 9
+
+    # Our own persistence (advance_append) completes the quorum for +10.
+    light_rd = node.advance_append(rd)
+    assert light_rd.commit_index == first_index + 10
+    assert light_rd.committed_entries[0].index == first_index + 1
+    assert light_rd.committed_entries[-1].index == first_index + 10
+    assert light_rd.messages
+
+
+def test_async_ready_follower():
+    """reference: test_raw_node.rs:1252-1402 (condensed): followers number
+    readies, persist asynchronously, and their append responses are
+    persisted_messages."""
+    s = MemStorage()
+    with s.wl() as core:
+        core.apply_snapshot(new_snapshot(1, 1, [1, 2]))
+    node = new_test_raw_node(1, [1, 2], 10, 1, s)
+    first_index = 1
+
+    for i in range(10):
+        # Leader 2 sends appends.
+        m = new_message(2, 1, MessageType.MsgAppend)
+        m.term = 1
+        m.index = first_index + i
+        m.log_term = 1
+        m.commit = first_index + i
+        m.entries = [Entry(term=1, index=first_index + i + 1)]
+        node.step(m)
+
+        rd = node.ready()
+        assert rd.number == i + 1
+        # Followers' responses wait for persistence.
+        assert not rd.messages()
+        assert rd.persisted_messages()
+        with s.wl() as core:
+            core.append(rd.entries)
+            if rd.hs is not None:
+                core.set_hardstate(rd.hs.clone())
+        node.advance_append_async(rd)
+
+    # Persist everything: the follower applies commits in order.
+    node.on_persist_ready(10)
+    rd = node.ready()
+    assert rd.committed_entries()
+    assert rd.committed_entries()[-1].index == first_index + 9
+    node.advance(rd)
+    node.advance_apply()
+
+
+def test_async_ready_multiple_snapshot():
+    """A ready with a snapshot resets the persistence bookkeeping
+    (reference: test_raw_node.rs:1503-1585, condensed)."""
+    s = MemStorage()
+    with s.wl() as core:
+        core.apply_snapshot(new_snapshot(1, 1, [1, 2]))
+    node = new_test_raw_node(1, [1, 2], 10, 1, s)
+
+    # A snapshot message arrives.
+    snap = new_snapshot(10, 2, [1, 2])
+    m = Message(msg_type=MessageType.MsgSnapshot, from_=2, to=1, term=2)
+    m.snapshot = snap
+    node.step(m)
+
+    rd = node.ready()
+    assert not rd.snapshot.is_empty()
+    assert rd.snapshot.metadata.index == 10
+    with s.wl() as core:
+        core.apply_snapshot(rd.snapshot.clone())
+        if rd.hs is not None:
+            core.set_hardstate(rd.hs.clone())
+    node.advance_append_async(rd)
+    node.on_persist_ready(rd.number)
+    assert node.raft.raft_log.persisted == 10
+
+
+def test_committed_entries_pagination_after_restart():
+    """Pagination must not lose entries across a restart
+    (reference: test_raw_node.rs:1645-1685)."""
+    s = MemStorage.new_with_conf_state(([1, 2, 3], []))
+    ents = []
+    for i in range(1, 11):
+        ents.append(Entry(term=1, index=i, data=b"a" * 8))
+    with s.wl() as core:
+        core.append(ents)
+        core.set_hardstate(HardState(term=1, vote=0, commit=10))
+
+    cfg = new_test_config(1, 10, 1)
+    # Tight page size: entries are 8 bytes + overhead.
+    cfg.max_committed_size_per_ready = 2 * (8 + 12)
+    node = RawNode(cfg, s)
+
+    got = []
+    for _ in range(20):
+        if not node.has_ready():
+            break
+        rd = node.ready()
+        got.extend(rd.take_committed_entries())
+        light = node.advance(rd)
+        got.extend(light.take_committed_entries())
+        node.advance_apply()
+    assert [e.index for e in got] == list(range(1, 11))
+
+
+def test_raw_node_entries_after_snapshot():
+    """Entries arriving after a snapshot persist correctly
+    (reference: test_raw_node.rs:900-985, condensed)."""
+    s = MemStorage()
+    with s.wl() as core:
+        core.apply_snapshot(new_snapshot(1, 1, [1, 2]))
+    node = new_test_raw_node(1, [1, 2], 10, 1, s)
+
+    snap = new_snapshot(10, 2, [1, 2])
+    m = Message(msg_type=MessageType.MsgSnapshot, from_=2, to=1, term=2)
+    m.snapshot = snap
+    node.step(m)
+
+    ap = new_message(2, 1, MessageType.MsgAppend)
+    ap.term = 2
+    ap.index = 10
+    ap.log_term = 2
+    ap.commit = 11
+    ap.entries = [Entry(term=2, index=11, data=b"hello")]
+    node.step(ap)
+
+    rd = node.ready()
+    assert not rd.snapshot.is_empty()
+    assert rd.entries and rd.entries[0].index == 11
+    assert rd.must_sync
+    with s.wl() as core:
+        core.apply_snapshot(rd.snapshot.clone())
+        core.append(rd.entries)
+        if rd.hs is not None:
+            core.set_hardstate(rd.hs.clone())
+    light = node.advance(rd)
+    node.advance_apply()
+    assert node.raft.raft_log.persisted == 11
+    assert node.raft.raft_log.committed == 11
+
+
+def test_raw_node_overwrite_entries():
+    """A conflicting append overwrites unpersisted entries and regresses
+    the persistence bookkeeping (reference: test_raw_node.rs:987-1072,
+    condensed)."""
+    s = MemStorage.new_with_conf_state(([1, 2], []))
+    node = new_test_raw_node(1, [1, 2], 10, 1, s)
+
+    ap = new_message(2, 1, MessageType.MsgAppend)
+    ap.term = 1
+    ap.index = 0
+    ap.log_term = 0
+    ap.commit = 1
+    ap.entries = [
+        Entry(term=1, index=1, data=b"a"),
+        Entry(term=1, index=2, data=b"b"),
+        Entry(term=1, index=3, data=b"c"),
+    ]
+    node.step(ap)
+    rd = node.ready()
+    with s.wl() as core:
+        core.append(rd.entries)
+        if rd.hs is not None:
+            core.set_hardstate(rd.hs.clone())
+    node.advance_append_async(rd)
+    node.on_persist_ready(rd.number)
+    assert node.raft.raft_log.persisted == 3
+
+    # A new term's append overwrites entries 2..3.
+    ap = new_message(2, 1, MessageType.MsgAppend)
+    ap.term = 2
+    ap.index = 1
+    ap.log_term = 1
+    ap.commit = 3
+    ap.entries = [
+        Entry(term=2, index=2, data=b"d"),
+        Entry(term=2, index=3, data=b"e"),
+    ]
+    node.step(ap)
+    # Persisted regressed to the conflict point.
+    assert node.raft.raft_log.persisted == 1
+    rd = node.ready()
+    assert [e.index for e in rd.entries] == [2, 3]
+    with s.wl() as core:
+        core.append(rd.entries)
+        if rd.hs is not None:
+            core.set_hardstate(rd.hs.clone())
+    node.advance(rd)
+    node.advance_apply()
+    assert node.raft.raft_log.persisted == 3
+    assert node.raft.raft_log.committed == 3
+
+
+def test_raw_node_read_index_to_old_leader():
+    """ReadIndex forwarded to a deposed leader gets re-forwarded
+    (reference: test_raw_node.rs:114-179, condensed)."""
+    from raft_tpu.harness import Network
+    from test_util import new_message_with_entries, new_entry
+
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.leader_id == 1
+
+    # elect 2 as the new leader
+    nt.send([new_message(2, 2, MessageType.MsgHup)])
+    assert nt.peers[2].raft.leader_id == 2
+
+    # node 1 still thinks... (it knows: it was deposed and follows 2).
+    # A read request sent to node 3 forwards to leader 2 and resolves.
+    nt.send([
+        new_message_with_entries(
+            3, 3, MessageType.MsgReadIndex, [new_entry(0, 0, b"ctx")]
+        )
+    ])
+    rs = nt.peers[3].raft.read_states
+    assert rs and rs[0].request_ctx == b"ctx"

@@ -1,0 +1,263 @@
+"""Final coverage batch: leader append-response matrix, §5.4.2 no-commit
+rule, transfer extras, pre-vote with check-quorum, config errors, learner
+vote responses (ported behaviors from reference: test_raft.rs)."""
+
+import pytest
+
+from raft_tpu import (
+    Config,
+    ConfChange,
+    ConfChangeError,
+    ConfChangeType,
+    ConfigInvalid,
+    Entry,
+    MemStorage,
+    MessageType,
+    StateRole,
+)
+from raft_tpu.harness import Network
+
+from test_util import (
+    empty_entry,
+    new_message,
+    new_snapshot,
+    new_test_config,
+    new_test_raft,
+    new_test_raft_with_config,
+    new_test_raft_with_prevote,
+)
+
+
+def test_leader_append_response():
+    """reference: test_raft.rs:2611-2677"""
+    tests = [
+        # (index, reject, wmatch, wnext, wmsg_num, windex, wcommitted)
+        (3, True, 0, 3, 0, 0, 0),  # stale rejection: no replies
+        (2, True, 0, 2, 1, 1, 0),  # denied: decrement next, probe
+        (2, False, 2, 4, 2, 2, 2),  # accepted: commit + broadcast
+        (0, False, 0, 3, 0, 0, 0),  # stale accept: ignored
+    ]
+    for i, (index, reject, wmatch, wnext, wmsg_num, windex, wcommitted) in enumerate(tests):
+        store = MemStorage.new_with_conf_state(([1, 2, 3], []))
+        with store.wl() as core:
+            core.append([empty_entry(0, 1), empty_entry(1, 2)])
+        sm = new_test_raft(1, [1, 2, 3], 10, 1, store)
+        sm.raft.become_candidate()
+        sm.raft.become_leader()
+        sm.read_messages()
+
+        m = new_message(2, 0, MessageType.MsgAppendResponse)
+        m.index = index
+        m.term = sm.raft.term
+        m.reject = reject
+        m.reject_hint = index
+        sm.step(m)
+
+        pr = sm.raft.prs.get(2)
+        assert pr.matched == wmatch, f"#{i}"
+        assert pr.next_idx == wnext, f"#{i}"
+        msgs = sm.read_messages()
+        assert len(msgs) == wmsg_num, f"#{i}: {len(msgs)}"
+        for j, msg in enumerate(msgs):
+            assert msg.index == windex, f"#{i}.{j}"
+            assert msg.commit == wcommitted, f"#{i}.{j}"
+
+
+def test_cannot_commit_without_new_term_entry():
+    """§5.4.2: a new leader cannot commit old-term entries by counting
+    replicas (reference: test_raft.rs:829-864)."""
+    tt = Network.new([None, None, None, None, None])
+    tt.send([new_message(1, 1, MessageType.MsgHup)])
+
+    tt.cut(1, 3)
+    tt.cut(1, 4)
+    tt.cut(1, 5)
+    tt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    tt.send([new_message(1, 1, MessageType.MsgPropose, 1)])
+    assert tt.peers[1].raft_log.committed == 1
+
+    tt.recover()
+    tt.ignore(MessageType.MsgAppend)
+    tt.send([new_message(2, 2, MessageType.MsgHup)])
+    assert tt.peers[2].raft_log.committed == 1
+
+    tt.recover()
+    tt.send([new_message(2, 2, MessageType.MsgBeat)])
+    tt.send([new_message(2, 2, MessageType.MsgPropose, 1)])
+    assert tt.peers[2].raft_log.committed == 5
+
+
+def test_leader_transfer_to_uptodate_node_from_follower():
+    """Transfer requests relayed through a follower work
+    (reference: test_raft.rs:3369-3388)."""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.leader_id == 1
+
+    # Transfer requested AT the follower 2 (it forwards to the leader).
+    nt.send([new_message(2, 2, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Follower
+    assert nt.peers[2].raft.state == StateRole.Leader
+    # and back, again via the (new) follower
+    nt.send([new_message(1, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+
+def test_leader_transfer_back():
+    """Transferring back to self aborts the in-flight transfer
+    (reference: test_raft.rs:3614-3631)."""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.isolate(3)
+    lead = nt.peers[1].raft
+
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert lead.lead_transferee == 3
+
+    # Transfer to self = abort.
+    nt.send([new_message(1, 1, MessageType.MsgTransferLeader)])
+    assert lead.state == StateRole.Leader
+    assert lead.lead_transferee is None
+
+
+def test_leader_transfer_second_transfer_to_same_node():
+    """reference: test_raft.rs:3652-3691"""
+    nt = Network.new([None, None, None])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.isolate(3)
+    lead = nt.peers[1].raft
+
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert lead.lead_transferee == 3
+
+    for _ in range(lead.heartbeat_timeout):
+        lead.tick()
+    # second request to the same node is a no-op
+    nt.send([new_message(3, 1, MessageType.MsgTransferLeader)])
+    assert lead.lead_transferee == 3
+
+    # after election timeout the transfer aborts
+    for _ in range(lead.election_timeout - lead.heartbeat_timeout):
+        lead.tick()
+    assert lead.lead_transferee is None
+
+
+def test_leader_transfer_to_learner():
+    """Leadership is never transferred to a learner
+    (reference: test_raft.rs:3500-3517)."""
+    s = MemStorage()
+    s.initialize_with_conf_state(([1], [2]))
+    cfg = new_test_config(1, 10, 1)
+    leader = new_test_raft_with_config(cfg, s)
+    s2 = MemStorage()
+    s2.initialize_with_conf_state(([1], [2]))
+    cfg2 = new_test_config(2, 10, 1)
+    learner = new_test_raft_with_config(cfg2, s2)
+    nt = Network.new([leader, learner])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    nt.send([new_message(2, 1, MessageType.MsgTransferLeader)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+
+def test_remove_node_itself():
+    """A leader removing itself keeps committing what's pending
+    (reference: test_raft.rs:3219-3227)."""
+    s = MemStorage()
+    s.initialize_with_conf_state(([1], [2]))
+    n1 = new_test_raft_with_config(new_test_config(1, 10, 1), s)
+    n1.raft.become_candidate()
+    n1.raft.become_leader()
+    with pytest.raises(ConfChangeError):
+        n1.raft.apply_conf_change(
+            ConfChange(change_type=ConfChangeType.RemoveNode, node_id=1).as_v2()
+        )
+
+
+def test_restore_learner():
+    """A learner-only snapshot restore on a voter is rejected
+    (reference: test_raft.rs:4009-4021)."""
+    s = new_snapshot(11, 11, [1, 2])
+    s.metadata.conf_state.learners = [3]
+    sm = new_test_raft(3, [1, 2, 3], 10, 1)
+    assert sm.raft.promotable
+    assert sm.raft.restore(s)
+    assert not sm.raft.promotable
+
+
+def test_learner_respond_vote():
+    """Learners do respond to vote requests but their votes never count
+    (reference: test_raft.rs:4214-4247, condensed)."""
+    storage = MemStorage()
+    storage.initialize_with_conf_state(([1, 2], [3]))
+    n3 = new_test_raft_with_config(new_test_config(3, 10, 1), storage)
+    n3.raft.become_follower(1, 0)
+
+    m = new_message(1, 3, MessageType.MsgRequestVote)
+    m.term = 2
+    m.log_term = 11
+    m.index = 11
+    n3.step(m)
+    msgs = n3.read_messages()
+    assert len(msgs) == 1
+    assert msgs[0].msg_type == MessageType.MsgRequestVoteResponse
+
+
+def test_prevote_with_check_quorum():
+    """Pre-vote + check-quorum: a pre-candidate is held off by leases but
+    the cluster stays electable (reference: test_raft.rs:4336-4403,
+    condensed)."""
+    a = new_test_raft_with_prevote(1, [1, 2, 3], 10, 1)
+    b = new_test_raft_with_prevote(2, [1, 2, 3], 10, 1)
+    c = new_test_raft_with_prevote(3, [1, 2, 3], 10, 1)
+    for n in (a, b, c):
+        n.raft.check_quorum = True
+    nt = Network.new([a, b, c])
+    nt.send([new_message(1, 1, MessageType.MsgHup)])
+    assert nt.peers[1].raft.state == StateRole.Leader
+
+    # isolate the leader; 2 and 3 lapse their leases and can elect
+    nt.isolate(1)
+    p2, p3 = nt.peers[2].raft, nt.peers[3].raft
+    for _ in range(p2.election_timeout + 1):
+        p2.tick()
+    for _ in range(p3.election_timeout + 1):
+        p3.tick()
+    nt.send(nt.filter(nt.peers[2].read_messages() + nt.peers[3].read_messages()))
+    nt.send([new_message(2, 2, MessageType.MsgHup)])
+    leaders = [i for i in (2, 3) if nt.peers[i].raft.state == StateRole.Leader]
+    assert len(leaders) == 1
+
+
+def test_new_raft_with_bad_config_errors():
+    """reference: test_raft.rs:4405-4412"""
+    from raft_tpu import Raft
+
+    storage = MemStorage.new_with_conf_state(([1, 2], []))
+    bad = Config(id=0, election_tick=10, heartbeat_tick=1)  # invalid id
+    with pytest.raises(ConfigInvalid):
+        Raft(bad, storage)
+
+
+def test_uncommitted_state_advance_ready_from_last_term():
+    """Reducing uncommitted size for entries from a previous leadership must
+    not underflow (reference: test_raft.rs:5516-5572, condensed)."""
+    cfg = Config(
+        id=1,
+        election_tick=5,
+        heartbeat_tick=1,
+        max_uncommitted_size=60,
+        max_inflight_msgs=256,
+    )
+    storage = MemStorage.new_with_conf_state(([1, 2, 3], []))
+    ents = [Entry(term=1, index=1, data=b"a" * 20), Entry(term=1, index=2, data=b"a" * 20)]
+    with storage.wl() as core:
+        core.append(ents)
+    from raft_tpu import Raft
+    from raft_tpu.harness import Interface
+
+    r = Interface(Raft(cfg, storage))
+    r.raft.become_candidate()
+    r.raft.become_leader()
+    # entries from the earlier term don't count against the new budget
+    r.raft.reduce_uncommitted_size(ents)
+    assert r.raft.uncommitted_size() == 0
