@@ -37,7 +37,7 @@ def settle(cfg, rounds=30):
 
 
 def test_steady_round_matches_xla():
-    cfg = SimConfig(n_groups=32, n_peers=5)
+    cfg = SimConfig(n_groups=16, n_peers=5)
     st = settle(cfg)
     crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
@@ -58,7 +58,7 @@ def test_steady_round_matches_xla():
 
 
 def test_steady_round_with_crashed_follower():
-    cfg = SimConfig(n_groups=16, n_peers=5)
+    cfg = SimConfig(n_groups=8, n_peers=5)
     st = settle(cfg)
     crashed = np.zeros((cfg.n_peers, cfg.n_groups), bool)
     # crash one non-leader peer per group
@@ -94,7 +94,7 @@ def test_predicate_rejects_non_steady():
 
 def test_multi_round_kernel_matches_k_steps():
     """k fused rounds == k sequential general steps from a steady state."""
-    cfg = SimConfig(n_groups=16, n_peers=3)
+    cfg = SimConfig(n_groups=8, n_peers=3)
     k = 4
     st = settle(cfg)
     crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
@@ -122,7 +122,7 @@ def test_fast_multi_round_full_schedule_parity():
     b = sim.init_state(cfg)
     crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
-    for blk in range(10):  # 40 rounds: covers the initial election storm
+    for blk in range(8):  # 32 rounds: covers the initial election storm
         for _ in range(k):
             a = sim.step(cfg, a, crashed, append)
         b = fast(b, crashed, append)
@@ -142,7 +142,7 @@ def test_fast_step_full_schedule_parity():
     b = sim.init_state(cfg)
     rng = np.random.RandomState(5)
     crashed = np.zeros((3, 8), bool)
-    for r in range(60):
+    for r in range(45):
         if rng.rand() < 0.05:
             crashed[rng.randint(3), rng.randint(8)] ^= True
         c = jnp.asarray(crashed)
