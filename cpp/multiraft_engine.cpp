@@ -222,6 +222,9 @@ struct Engine {
                ps[c].last_index >= pv.last_index);
           if (up_to_date) {
             pv.vote = c + 1;
+            // granting a real vote resets the election timer
+            // (reference: raft.rs:1445-1449)
+            pv.election_elapsed = 0;
             grant_of[v] = c;
             break;
           }

@@ -82,7 +82,7 @@ from .tracker import (
     ProgressState,
     ProgressTracker,
 )
-from .util import majority
+from .util import default_logger, majority
 
 __version__ = "0.1.0"
 
@@ -129,6 +129,7 @@ __all__ = [
     "StateRole",
     "Status",
     "majority",
+    "default_logger",
     "conf_state_eq",
     "is_local_msg",
     "vote_resp_msg_type",

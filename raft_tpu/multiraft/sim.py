@@ -412,8 +412,10 @@ def step(
             commit_run = jnp.where(vs_apply, c_req_commit[None, :], commit_run)
         commit_c = jnp.maximum(commit_run, cand_ff)
 
-        # Record granted votes (reference: raft.rs:1445-1449).
+        # Record granted votes; granting a REAL vote also resets the
+        # voter's election timer (reference: raft.rs:1445-1449).
         vote_c = jnp.where(grant_to >= 0, grant_to + 1, vote_c)
+        ee_c = jnp.where(grant_to >= 0, 0, ee_c)
 
         # Winner becomes leader and appends its noop entry (reference:
         # raft.rs:1151-1202); losers with a decided election step down.

@@ -90,3 +90,23 @@ def deterministic_timeout(node_key: int, term: int, lo: int, hi: int) -> int:
     """
     assert hi > lo
     return lo + mix32((node_key * 0x9E3779B1 + term) & _U32) % (hi - lo)
+
+
+def default_logger(name: str = "raft_tpu"):
+    """Structured logger for the library (the reference's `default_logger`,
+    lib.rs:576-600, adapted to stdlib logging: one stream handler, env-
+    filtered via RAFT_TPU_LOG, attached once)."""
+    import logging
+    import os
+
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("RAFT_TPU_LOG", "WARNING").upper())
+    return logger

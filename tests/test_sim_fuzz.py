@@ -102,3 +102,10 @@ def test_fuzz_fresh_seeds():
     run_fuzz(108, 3, 5, 140)
     run_fuzz(205, 3, 5, 120, joint=True)
     run_fuzz(307, 3, 5, 120, learners=True)
+
+
+def test_fuzz_regression_even_peer_split_votes():
+    # seed 1004 at P=4 historically: vote grants must reset the voter's
+    # election timer (raft.rs:1445-1449); split votes at even P exposed it.
+    run_fuzz(1004, 3, 4, 160)
+    run_fuzz(1010, 3, 4, 140)
