@@ -178,6 +178,16 @@ struct Engine {
             if (is_learner) {
               ps[p].election_elapsed = 0;
               ps[p].leader_id = pl + 1;
+              // lower-term learners become followers at the deposed
+              // leader's term and stay there (voters get re-bumped by the
+              // vote requests; learners receive none).
+              if (ps[p].term < plt) {
+                ps[p].term = plt;
+                ps[p].vote = 0;
+                ps[p].randomized_timeout = timeout_draw(
+                    node_key(gi, p), ps[p].term, election_tick,
+                    2 * election_tick);
+              }
             }
           }
         }

@@ -294,6 +294,15 @@ def step(
         )
         ee = jnp.where(apply_l, 0, ee)
         leader_id = jnp.where(apply_l, prev_first + 1, leader_id)
+        # A lower-term learner receiving the heartbeat becomes a follower at
+        # the (deposed) leader's term — and, unlike voters, is never
+        # re-bumped by the vote requests, so the change persists
+        # (reference: raft.rs:1340-1344 become_follower on higher-term
+        # heartbeat).
+        lrn_bump = apply_l & (term < prev_lt)
+        term = jnp.where(lrn_bump, prev_lt, term)
+        vote = jnp.where(lrn_bump, 0, vote)
+        rt = jnp.where(lrn_bump, draw(term), rt)
 
         # Receiving a higher-term request makes any alive VOTER a follower
         # at that term with vote cleared (reference: raft.rs:1284-1348;

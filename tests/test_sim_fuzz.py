@@ -109,3 +109,72 @@ def test_fuzz_regression_even_peer_split_votes():
     # election timer (raft.rs:1445-1449); split votes at even P exposed it.
     run_fuzz(1004, 3, 4, 160)
     run_fuzz(1010, 3, 4, 140)
+
+
+def test_fuzz_regression_learner_heartbeat_term_bump():
+    # seeds 2004/2007 at P=6 (voters {1,2,3,4}, outgoing {3,4,5},
+    # learner {6}) historically: a deposed leader's queued heartbeat must
+    # still term-bump lower-term learners (voters get re-bumped by vote
+    # requests; learners receive none).
+    run_fuzz_mixed(2004)
+    run_fuzz_mixed(2007)
+
+
+def run_fuzz_mixed(seed):
+    G, P = 2, 6
+    voters, outgoing, learner_ids = [1, 2, 3, 4], [3, 4, 5], [6]
+    vm_np = np.zeros((P, G), bool)
+    om_np = np.zeros((P, G), bool)
+    lm_np = np.zeros((P, G), bool)
+    for id in voters:
+        vm_np[id - 1] = True
+    for id in outgoing:
+        om_np[id - 1] = True
+    for id in learner_ids:
+        lm_np[id - 1] = True
+    scalar = ScalarCluster(
+        G, P, voters=voters, voters_outgoing=outgoing, learners=learner_ids
+    )
+    sim = ClusterSim(
+        SimConfig(n_groups=G, n_peers=P),
+        jnp.asarray(vm_np),
+        jnp.asarray(om_np),
+        jnp.asarray(lm_np),
+    )
+    native = NativeMultiRaft(G, P)
+    native.set_config(
+        np.ascontiguousarray(vm_np.T).astype(np.uint8),
+        np.ascontiguousarray(om_np.T).astype(np.uint8),
+        np.ascontiguousarray(lm_np.T).astype(np.uint8),
+    )
+    rng = np.random.RandomState(seed)
+    crashed = np.zeros((G, P), bool)
+    for r in range(160):
+        for g in range(G):
+            roll = rng.rand()
+            if roll < 0.08:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            elif roll < 0.12:
+                snap = scalar.snapshot()
+                leaders = np.where(snap["state"][g] == 2)[0]
+                if len(leaders):
+                    crashed[g, leaders[0]] = True
+            elif roll < 0.14:
+                crashed[g, :] = False
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        append = rng.randint(0, 3, size=G).astype(np.int64)
+        scalar.round(crashed, append)
+        sim.run_round(
+            jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32)
+        )
+        native.step(crashed, append)
+        want = scalar.snapshot()
+        nat = native.snapshot()
+        for f in FIELDS:
+            dev = np.asarray(getattr(sim.state, f)).T
+            assert np.array_equal(want[f], dev), f"seed {seed} r{r} DEVICE {f}"
+            assert np.array_equal(
+                want[f].astype(np.int32), nat[f]
+            ), f"seed {seed} r{r} NATIVE {f}"
