@@ -508,6 +508,52 @@ void mr_read_state2(void* h, int32_t* vote, int32_t* ee, int32_t* hb,
     }
 }
 
+// Batched linearizable ReadIndex barrier (Safe mode) — mirrors
+// sim.read_index: per group, the index a read at the acting leader would
+// return at this round boundary, or -1 (no leader / no current-term commit /
+// ack quorum blocked by a higher-term member in peer-id order).
+void mr_read_index(void* h, const uint8_t* crashed, int32_t* out) {
+  auto* e = static_cast<Engine*>(h);
+  for (int gi = 0; gi < e->G; ++gi) {
+    auto& grp = e->groups[gi];
+    auto& ps = grp.peers;
+    const uint8_t* cr = crashed + size_t(gi) * e->P;
+    int lead = -1;
+    int32_t lead_term = -1;
+    for (int p = 0; p < e->P; ++p)
+      if (!cr[p] && ps[p].state == ROLE_LEADER && ps[p].term > lead_term) {
+        lead = p;
+        lead_term = ps[p].term;
+      }
+    out[gi] = -1;
+    if (lead < 0) continue;
+    if (ps[lead].commit < grp.term_start_index[lead]) continue;
+    int n_i = 0, n_o = 0;
+    for (int p = 0; p < e->P; ++p) {
+      n_i += e->vot(gi, p) ? 1 : 0;
+      n_o += e->outg(gi, p) ? 1 : 0;
+    }
+    bool singleton = (n_i == 1 && n_o == 0);
+    int first_higher = e->P;
+    for (int p = 0; p < e->P; ++p)
+      if (!cr[p] && e->member(gi, p) && ps[p].term > lead_term) {
+        first_higher = p;
+        break;
+      }
+    int a_i = 0, a_o = 0;
+    for (int p = 0; p < e->P; ++p) {
+      bool acks =
+          (p == lead) || (!cr[p] && e->member(gi, p) && p < first_higher);
+      if (!acks) continue;
+      a_i += e->vot(gi, p) ? 1 : 0;
+      a_o += e->outg(gi, p) ? 1 : 0;
+    }
+    bool q = (n_i == 0 || a_i >= n_i / 2 + 1) &&
+             (n_o == 0 || a_o >= n_o / 2 + 1);
+    if (singleton || q) out[gi] = ps[lead].commit;
+  }
+}
+
 // Debug: dump agree planes [G, P, P].
 void mr_read_agree(void* h, int32_t* out) {
   auto* e = static_cast<Engine*>(h);

@@ -26,7 +26,7 @@ from .kernels import (
     timeout_draw,
     vote_result,
 )
-from .sim import ClusterSim, SimConfig, SimState
+from .sim import ClusterSim, SimConfig, SimState, read_index
 from .simref import ScalarCluster
 
 __all__ = [
@@ -40,6 +40,7 @@ __all__ = [
     "SimConfig",
     "SimState",
     "ScalarCluster",
+    "read_index",
     # submodules imported lazily to keep jax-light paths cheap:
     #   .driver    MultiRaft host driver
     #   .native    NativeMultiRaft C++ engine bindings

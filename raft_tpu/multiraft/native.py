@@ -69,6 +69,11 @@ def load_library() -> ctypes.CDLL:
         lib.mr_read_state.argtypes = [ctypes.c_void_p] + [
             ctypes.POINTER(ctypes.c_int32)
         ] * 5
+        lib.mr_read_index.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _lib = lib
         return lib
 
@@ -132,6 +137,23 @@ class NativeMultiRaft:
     def run(self, rounds: int, crashed=None, append_n=None) -> None:
         c, a, cp, ap = self._bufs(crashed, append_n)
         self.lib.mr_run(self.handle, cp, ap, rounds)
+
+    def read_index(self, crashed=None) -> np.ndarray:
+        """Linearizable ReadIndex barrier per group: the index a Safe-mode
+        read at the acting leader would return now, or -1 when it cannot
+        complete (no leader / no current-term commit / ack quorum blocked).
+        Mirrors sim.read_index exactly."""
+        if crashed is None:
+            crashed = np.zeros((self.G, self.P), dtype=np.uint8)
+        else:
+            crashed = np.ascontiguousarray(crashed, dtype=np.uint8)
+        out = np.zeros((self.G,), dtype=np.int32)
+        self.lib.mr_read_index(
+            self.handle,
+            crashed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
 
     def snapshot(self) -> dict:
         shape = (self.G, self.P)

@@ -127,6 +127,20 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     return jax.jit(fn)
 
 
+def sharded_read_index(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
+    """Compile the ReadIndex barrier (sim.read_index) under group-axis
+    sharding: each chip answers reads for its own group shard with zero
+    cross-chip traffic — the consensus analog of a data-parallel inference
+    step.  Returns a jitted fn (SimState, crashed[P, G]) -> int32[G]."""
+    shardings = state_sharding(mesh, axis)
+    crashed_sh = NamedSharding(mesh, P(None, axis))
+    return jax.jit(
+        functools.partial(sim.read_index, cfg),
+        in_shardings=(shardings, crashed_sh),
+        out_shardings=NamedSharding(mesh, P(axis)),
+    )
+
+
 def run_sharded(
     cfg: SimConfig,
     mesh: Mesh,

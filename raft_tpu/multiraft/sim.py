@@ -587,6 +587,59 @@ def step(
     )
 
 
+def read_index(
+    cfg: SimConfig, st: SimState, crashed: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched linearizable ReadIndex barrier, Safe mode (reference:
+    read_only.rs:65-140 + raft.rs step_leader MsgReadIndex 2067-2096 +
+    handle_heartbeat_response ack-quorum 1805-1818): for every group, the
+    index a read issued at the acting leader at this round boundary would
+    return, or -1 when it cannot complete:
+
+      * no alive leader, or
+      * the leader has not committed an entry in its own term yet
+        (commit < term_start_index — the commit_to_current_term gate), or
+      * the ack quorum fails: heartbeat acks accumulate from alive members
+        in peer-id order, but an alive member at a HIGHER term deposes the
+        leader with its response, so only ackers ordered before the first
+        such peer count (the leader's own ack from add_request always
+        counts).  Joint configs need both majorities; a singleton group
+        answers immediately without heartbeats (raft.rs:2075-2079).
+
+    Pure and jittable: probing reads never mutates `st` (the scalar oracle's
+    probe DOES perturb its cluster, so parity tests probe last).
+    Returns int32[G].
+    """
+    P = cfg.n_peers
+    alive = ~crashed
+    member = st.voter_mask | st.outgoing_mask | st.learner_mask
+    is_lead = (st.state == ROLE_LEADER) & alive
+    lead_term = jnp.max(jnp.where(is_lead, st.term, -1), axis=0)  # [G]
+    acting = is_lead & (st.term == lead_term[None, :])  # [P, G], unique
+    has_lead = jnp.any(acting, axis=0)
+    lead_commit = jnp.sum(jnp.where(acting, st.commit, 0), axis=0)
+    lead_ts = jnp.sum(jnp.where(acting, st.term_start_index, 0), axis=0)
+    servable = has_lead & (lead_commit >= lead_ts)
+
+    n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+    n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+    singleton = (n_i == 1) & (n_o == 0)
+
+    pos = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
+    higher = alive & member & (st.term > lead_term[None, :])
+    first_higher = jnp.min(jnp.where(higher, pos, P), axis=0)  # [G]
+    acker = (alive & member & (pos < first_higher[None, :])) | acting
+
+    def half_quorum(mask):
+        n = jnp.sum(mask, axis=0).astype(jnp.int32)
+        acks = jnp.sum(acker & mask, axis=0).astype(jnp.int32)
+        return (acks >= n // 2 + 1) | (n == 0)
+
+    quorum = half_quorum(st.voter_mask) & half_quorum(st.outgoing_mask)
+    ok = servable & (singleton | quorum)
+    return jnp.where(ok, lead_commit, jnp.int32(-1))
+
+
 class ClusterSim:
     """Convenience wrapper: jitted step + host-friendly runners.  Arrays are
     peer-major [P, G]."""
@@ -615,3 +668,13 @@ class ClusterSim:
         for _ in range(rounds):
             self.run_round(crashed, append_n)
         return self.state
+
+    def read_index(self, crashed=None) -> jnp.ndarray:
+        """Batched linearizable ReadIndex barrier (see sim.read_index)."""
+        if crashed is None:
+            crashed = jnp.zeros(
+                (self.cfg.n_peers, self.cfg.n_groups), bool
+            )
+        return jax.jit(functools.partial(read_index, self.cfg))(
+            self.state, crashed
+        )

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 from raft_tpu.multiraft import ClusterSim, SimConfig
 from raft_tpu.multiraft import sharding
 from raft_tpu.multiraft.sim import init_state
+from raft_tpu.multiraft import sim
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def test_mesh_has_8_devices():
@@ -50,3 +52,20 @@ def test_global_status_collectives():
     assert status["min_commit"] >= 1
     assert status["max_term"] >= 1
     assert status["total_commit"] >= cfg.n_groups
+
+
+def test_sharded_read_index_matches_local():
+    cfg = SimConfig(n_groups=32, n_peers=5)
+    mesh = sharding.make_mesh()
+    st = sim.init_state(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    for _ in range(25):
+        st = sim.step(cfg, st, crashed, append)
+    want = np.asarray(sim.read_index(cfg, st, crashed))
+    assert (want >= 0).all()  # settled: every group serves reads
+    st_sh = sharding.shard_state(st, mesh)
+    fn = sharding.sharded_read_index(cfg, mesh)
+    got = np.asarray(fn(st_sh, jax.device_put(
+        crashed, NamedSharding(mesh, P(None, "groups")))))
+    np.testing.assert_array_equal(want, got)
