@@ -143,6 +143,30 @@ struct Engine {
           req[p] = true;
           ++n_req;
           t_star = std::max(t_star, pr.term);
+        } else {
+          // A campaigner that is the sole voter of both config halves wins
+          // LOCALLY (campaign -> self-vote -> quorum of 1 -> become_leader
+          // + noop + self-commit, raft.rs:1217-1263) — isolation cannot
+          // stop it.  Alive solo campaigners go through the normal
+          // election path below.
+          int n_i = 0, n_o = 0;
+          for (int q = 0; q < P; ++q) {
+            n_i += vot(gi, q) ? 1 : 0;
+            n_o += outg(gi, q) ? 1 : 0;
+          }
+          bool solo = (n_i == 0 || (n_i == 1 && vot(gi, p))) &&
+                      (n_o == 0 || (n_o == 1 && outg(gi, p)));
+          if (solo) {
+            pr.state = ROLE_LEADER;
+            pr.leader_id = p + 1;
+            pr.last_index += 1;  // noop
+            pr.last_term = pr.term;
+            grp.term_start_index[p] = pr.last_index;
+            for (int q = 0; q < P; ++q) grp.matched[p][q] = 0;
+            grp.matched[p][p] = pr.last_index;
+            pr.commit = pr.last_index;
+            pr.heartbeat_elapsed = 0;
+          }
         }
       }
     }

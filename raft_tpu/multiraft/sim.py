@@ -476,6 +476,39 @@ def step(
         ),
     )
 
+    # ---- Phase C': a campaigner that is the sole voter of both config
+    # halves wins its election LOCALLY — campaign, self-vote, quorum of 1,
+    # become_leader, noop append, self-commit — with no network traffic, so
+    # isolation does not stop it (reference: campaign raft.rs:1217-1263,
+    # where poll() after the self-vote returns Won before any message is
+    # sent; found by singleton-config fuzz).  Alive solo campaigners go
+    # through the normal election branch; this handles crashed ones, which
+    # `req = want_campaign & alive` excludes.
+    def _half_solo(mask):
+        n = jnp.sum(mask, axis=0).astype(jnp.int32)  # [G]
+        return (n[None, :] == 0) | ((n[None, :] == 1) & mask)
+
+    solo_win = (
+        want_campaign
+        & crashed
+        & _half_solo(st.voter_mask)
+        & _half_solo(st.outgoing_mask)
+    )
+    state = jnp.where(solo_win, ROLE_LEADER, state)
+    leader_id = jnp.where(solo_win, self_id, leader_id)
+    new_last_index = new_last_index + solo_win.astype(jnp.int32)  # noop
+    new_last_term = jnp.where(solo_win, term, new_last_term)
+    term_start = jnp.where(solo_win, new_last_index, term_start)
+    matched = jnp.where(solo_win[:, None, :], 0, matched)
+    matched = jnp.where(
+        solo_win[:, None, :]
+        & (jnp.arange(P)[None, :, None] == jnp.arange(P)[:, None, None]),
+        new_last_index[:, None, :],
+        matched,
+    )
+    commit_c = jnp.where(solo_win, new_last_index, commit_c)
+    hb = jnp.where(solo_win, 0, hb)
+
     # ---- Phase D: replication round for groups with an alive leader.
     is_leader = (state == ROLE_LEADER) & alive
     has_leader = jnp.any(is_leader, axis=0)  # [G]

@@ -178,3 +178,70 @@ def run_fuzz_mixed(seed):
             assert np.array_equal(
                 want[f].astype(np.int32), nat[f]
             ), f"seed {seed} r{r} NATIVE {f}"
+
+
+def test_fuzz_regression_singleton_voter():
+    # seed 7001 historically: a CRASHED singleton voter still wins its
+    # election locally (campaign -> self-vote -> quorum of 1 ->
+    # become_leader + noop + self-commit, no network involved); the device
+    # and C++ backends excluded crashed peers from the election phase
+    # entirely.
+    for seed in (7000, 7001, 7002):
+        run_fuzz_config(seed, 2, 3, 160, voters=[1], learners=[2, 3])
+
+
+def run_fuzz_config(seed, G, P, rounds, voters, outgoing=None, learners=None):
+    vm_np = np.zeros((P, G), bool)
+    om_np = np.zeros((P, G), bool)
+    lm_np = np.zeros((P, G), bool)
+    for id in voters:
+        vm_np[id - 1] = True
+    for id in outgoing or []:
+        om_np[id - 1] = True
+    for id in learners or []:
+        lm_np[id - 1] = True
+    scalar = ScalarCluster(
+        G, P, voters=voters, voters_outgoing=outgoing or [],
+        learners=learners or [],
+    )
+    sim = ClusterSim(
+        SimConfig(n_groups=G, n_peers=P),
+        jnp.asarray(vm_np), jnp.asarray(om_np), jnp.asarray(lm_np),
+    )
+    native = NativeMultiRaft(G, P)
+    native.set_config(
+        np.ascontiguousarray(vm_np.T).astype(np.uint8),
+        np.ascontiguousarray(om_np.T).astype(np.uint8),
+        np.ascontiguousarray(lm_np.T).astype(np.uint8),
+    )
+    rng = np.random.RandomState(seed)
+    crashed = np.zeros((G, P), bool)
+    for r in range(rounds):
+        for g in range(G):
+            roll = rng.rand()
+            if roll < 0.08:
+                p = rng.randint(P)
+                crashed[g, p] = not crashed[g, p]
+            elif roll < 0.12:
+                snap = scalar.snapshot()
+                leaders = np.where(snap["state"][g] == 2)[0]
+                if len(leaders):
+                    crashed[g, leaders[0]] = True
+            elif roll < 0.14:
+                crashed[g, :] = False
+            if crashed[g].sum() == P:
+                crashed[g, rng.randint(P)] = False
+        append = rng.randint(0, 3, size=G).astype(np.int64)
+        scalar.round(crashed, append)
+        sim.run_round(
+            jnp.asarray(crashed.T), jnp.asarray(append, dtype=jnp.int32)
+        )
+        native.step(crashed, append)
+        want = scalar.snapshot()
+        nat = native.snapshot()
+        for f in FIELDS:
+            dev = np.asarray(getattr(sim.state, f)).T
+            assert np.array_equal(want[f], dev), f"seed {seed} r{r} DEVICE {f}"
+            assert np.array_equal(
+                want[f].astype(np.int32), nat[f]
+            ), f"seed {seed} r{r} NATIVE {f}"
