@@ -177,6 +177,50 @@ def bench_baseline_configs(results, quick):
 
     if not quick:
         results.append(bench_config4_joint_churn())
+        results.append(bench_read_barrier())
+
+
+def bench_read_barrier():
+    """Batched linearizable ReadIndex barrier (sim.read_index) at 100k
+    groups: reads/sec the batch can answer — TiKV-style follower-read /
+    lease-read traffic is orders of magnitude hotter than writes, so the
+    barrier must not touch the step's critical path (it is a pure gather +
+    two quorum counts per group)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.multiraft import sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    G, P = 100_000, 5
+    cfg = SimConfig(n_groups=G, n_peers=P)
+    st = sim.init_state(cfg)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    step = jax.jit(functools.partial(sim.step, cfg))
+    for _ in range(60):  # settle past the split-vote tail: all groups elect
+        st = step(st, crashed, append)
+    reads = 50
+    ri = jax.jit(functools.partial(sim.read_index, cfg))
+
+    @jax.jit
+    def many(st, crashed):
+        def body(acc, _):
+            return acc + sim.read_index(cfg, st, crashed), ()
+
+        return jax.lax.scan(
+            body, jnp.zeros((G,), jnp.int32), None, length=reads
+        )[0]
+
+    out = ri(st, crashed)
+    assert int(out.min()) >= 0, "read barrier returned -1 on settled batch"
+    jax.block_until_ready(many(st, crashed))
+    t0 = time.perf_counter()
+    jax.block_until_ready(many(st, crashed))
+    dt = time.perf_counter() - t0
+    return ("read_index: 100k x 5 barrier", G * reads / dt / 1e6, "M reads/s")
 
 
 def bench_config4_joint_churn():
