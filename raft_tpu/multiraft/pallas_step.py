@@ -233,13 +233,12 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
     return fn
 
 
-def steady_predicate(
+def steady_mask(
     cfg: SimConfig, st: SimState, crashed: jnp.ndarray, horizon: int = 1
 ) -> jnp.ndarray:
-    """True iff EVERY group provably satisfies the steady invariant for the
-    next `horizon` rounds: no election timer can fire (conservatively:
-    ee + horizon < rt for every non-leader voter), exactly one alive leader,
-    and every alive peer already shares the leader's term."""
+    """bool[G]: per-group steady invariant for the next `horizon` rounds —
+    no election timer can fire, exactly one alive leader, every alive peer
+    already at the leader's term, not in joint config."""
     alive = ~crashed
     # 1. nobody can campaign within the horizon.  With heartbeat_tick == 1
     # an alive follower under a live leader is re-synced (ee -> 0) every
@@ -263,17 +262,25 @@ def steady_predicate(
         may_fire = non_leader_voter & (
             st.election_elapsed + horizon >= st.randomized_timeout
         )
-    no_campaign = ~jnp.any(may_fire)
+    no_campaign = ~jnp.any(may_fire, axis=0)  # [G]
     # 2. exactly one alive leader per group
     is_leader = (st.state == ROLE_LEADER) & alive
-    one_leader = jnp.all(jnp.sum(is_leader.astype(jnp.int32), axis=0) == 1)
+    one_leader = jnp.sum(is_leader.astype(jnp.int32), axis=0) == 1
     # 3. alive peers at the leader's term
     lead_term = jnp.max(jnp.where(is_leader, st.term, 0), axis=0)
-    terms_ok = jnp.all(jnp.where(alive, st.term == lead_term, True))
-    # 4. no joint configs in the batch (the fused kernel computes the
-    # single-majority quorum; joint groups take the general XLA path)
-    not_joint = ~jnp.any(st.outgoing_mask)
+    terms_ok = jnp.all(jnp.where(alive, st.term == lead_term, True), axis=0)
+    # 4. not joint (the fused kernel computes the single-majority quorum;
+    # joint groups take the general XLA path)
+    not_joint = ~jnp.any(st.outgoing_mask, axis=0)
     return no_campaign & one_leader & terms_ok & not_joint
+
+
+def steady_predicate(
+    cfg: SimConfig, st: SimState, crashed: jnp.ndarray, horizon: int = 1
+) -> jnp.ndarray:
+    """True iff EVERY group satisfies the steady invariant (see
+    steady_mask)."""
+    return jnp.all(steady_mask(cfg, st, crashed, horizon))
 
 
 def fast_step(cfg: SimConfig):
@@ -315,6 +322,75 @@ def fast_multi_round(cfg: SimConfig, k: int = 16):
             lambda args: pallas_fn(*args),
             slow,
             (st, crashed, append_n),
+        )
+
+    return fn
+
+
+def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
+    """k protocol rounds with a PER-GROUP steady/slow split.
+
+    fast_multi_round drops the ENTIRE batch to k sequential general steps
+    when ANY group is non-steady — so one election among 100k groups costs
+    the whole batch its ~3-4x fused-kernel advantage.  This dispatcher
+    instead gathers the (few) non-steady groups into a fixed-capacity
+    [P, storm_slots] sub-batch (static shapes: an argsort permutation, storm
+    groups first), advances the sub-batch with k general sim.steps (passing
+    global group_ids so each group's (group, term)-keyed timeout PRNG stream
+    is unchanged), runs the fused kernel over the full batch, and scatters
+    the sub-batch results over the storm groups' (discarded) fused outputs.
+    Groups are independent in the lockstep model, so the split is exact —
+    bit-identical to k sequential sim.steps (tests/test_pallas_step.py).
+
+    Falls back to k general steps on the whole batch only when more than
+    `storm_slots` groups are non-steady (mass storms: elections at boot,
+    correlated failures)."""
+    G = cfg.n_groups
+    S = min(storm_slots, G)
+    pallas_fn = steady_round(cfg, rounds=k)
+    sub_cfg = cfg._replace(n_groups=S)
+
+    def slow(args):
+        st, crashed, append_n = args
+
+        def body(s, _):
+            return sim_mod.step(cfg, s, crashed, append_n), ()
+
+        return jax.lax.scan(body, st, None, length=k)[0]
+
+    def hybrid(args):
+        st, crashed, append_n = args
+        mask = steady_mask(cfg, st, crashed, horizon=k)  # [G] True = steady
+        # Stable sort: storm groups (False=0) first, original order kept.
+        order = jnp.argsort(mask.astype(jnp.int8), stable=True)
+        idx = order[:S]  # [S] global ids of the storm groups (+ padding)
+        take_sub = ~mask[idx]  # padding entries are steady -> keep fused
+
+        sub = jax.tree.map(lambda a: a[..., idx], st)
+        sub_crashed = crashed[:, idx]
+        sub_append = append_n[idx]
+
+        def body(s, _):
+            return (
+                sim_mod.step(sub_cfg, s, sub_crashed, sub_append, group_ids=idx),
+                (),
+            )
+
+        sub_out = jax.lax.scan(body, sub, None, length=k)[0]
+        fast_out = pallas_fn(st, crashed, append_n)
+
+        def merge(fast, subv):
+            gathered = jnp.where(take_sub, subv, fast[..., idx])
+            return fast.at[..., idx].set(gathered)
+
+        return jax.tree.map(merge, fast_out, sub_out)
+
+    def fn(st: SimState, crashed, append_n) -> SimState:
+        n_storm = jnp.sum(
+            ~steady_mask(cfg, st, crashed, horizon=k)
+        ).astype(jnp.int32)
+        return jax.lax.cond(
+            n_storm <= S, hybrid, slow, (st, crashed, append_n)
         )
 
     return fn

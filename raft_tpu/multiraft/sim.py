@@ -113,10 +113,19 @@ class SimState(NamedTuple):
     learner_mask: jnp.ndarray  # [P, G]
 
 
-def _node_key(cfg: SimConfig) -> jnp.ndarray:
+def _node_key(
+    cfg: SimConfig, group_ids: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
     """node_key[p, g] = g * 2**16 + (p + 1): matches the scalar side's
-    Config.timeout_seed = g convention (util.deterministic_timeout)."""
-    g = jnp.arange(cfg.n_groups, dtype=jnp.uint32)[None, :]
+    Config.timeout_seed = g convention (util.deterministic_timeout).
+
+    `group_ids` overrides the iota when the step runs on a GATHERED
+    sub-batch (pallas_step.hybrid_multi_round's storm slots): the timeout
+    PRNG must keep drawing from each group's GLOBAL stream."""
+    if group_ids is None:
+        g = jnp.arange(cfg.n_groups, dtype=jnp.uint32)[None, :]
+    else:
+        g = group_ids.astype(jnp.uint32)[None, :]
     p = jnp.arange(cfg.n_peers, dtype=jnp.uint32)[:, None]
     return g * jnp.uint32(1 << 16) + (p + 1)
 
@@ -201,11 +210,14 @@ def step(
     st: SimState,
     crashed: jnp.ndarray,
     append_n: jnp.ndarray,
+    group_ids: Optional[jnp.ndarray] = None,
 ) -> SimState:
     """One lockstep protocol round for every group.
 
     crashed:  bool[P, G] peers isolated this round (keep ticking, no I/O)
     append_n: int32[G]   entries proposed at the group's leader this round
+    group_ids: optional int32[G] global group ids when st is a gathered
+               sub-batch (keeps the per-(group, term) timeout PRNG global)
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -214,7 +226,7 @@ def step(
     G, P = cfg.n_groups, cfg.n_peers
     self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
     alive = ~crashed
-    node_key = _node_key(cfg)
+    node_key = _node_key(cfg, group_ids)
     lo = jnp.full((P, G), cfg.min_timeout, jnp.int32)
     hi = jnp.full((P, G), cfg.max_timeout, jnp.int32)
 

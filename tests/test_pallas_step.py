@@ -155,3 +155,65 @@ def test_fast_step_full_schedule_parity():
                 np.asarray(getattr(b, f)),
                 err_msg=f"round {r} field {f}",
             )
+
+
+def test_hybrid_multi_round_localized_storm_parity():
+    """hybrid_multi_round == k sequential sim.steps when a FEW groups storm
+    (leader crashes -> elections) while the rest stay steady: the storm
+    groups must ride the gathered general-step sub-batch (with global
+    timeout PRNG streams) and everyone else the fused kernel."""
+    cfg = SimConfig(n_groups=16, n_peers=3)
+    k = 4
+    hybrid = pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=4)
+    a = sim.init_state(cfg)
+    b = sim.init_state(cfg)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    crashed_np = np.zeros((cfg.n_peers, cfg.n_groups), bool)
+
+    def run_block(a, b, crashed):
+        c = jnp.asarray(crashed)
+        for _ in range(k):
+            a = sim.step(cfg, a, c, append)
+        b = hybrid(b, c, append)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f,
+            )
+        return a, b
+
+    # settle (the boot storm exceeds storm_slots=4 -> whole-batch fallback)
+    for _ in range(8):
+        a, b = run_block(a, b, crashed_np)
+    # kill the leaders of 2 groups: localized storms, 14 groups steady
+    leaders = np.asarray(a.state).argmax(axis=0)
+    for g in (3, 11):
+        crashed_np[leaders[g], g] = True
+    for _ in range(6):
+        a, b = run_block(a, b, crashed_np)
+    # recover: re-sync storms, then fully steady again
+    crashed_np[:] = False
+    for _ in range(6):
+        a, b = run_block(a, b, crashed_np)
+
+
+def test_hybrid_storm_overflow_falls_back():
+    """More storm groups than slots: exact whole-batch general fallback."""
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    k = 3
+    hybrid = pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=1)
+    a = sim.init_state(cfg)  # boot: all 8 groups non-steady
+    b = sim.init_state(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    for blk in range(10):
+        for _ in range(k):
+            a = sim.step(cfg, a, crashed, append)
+        b = hybrid(b, crashed, append)
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f"block {blk} field {f}",
+            )
