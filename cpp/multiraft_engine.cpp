@@ -305,13 +305,27 @@ struct Engine {
             if (outg(gi, v)) ++n_o;
           }
           int q_i = n_i / 2 + 1, q_o = n_o / 2 + 1;
+          int rec_i = cnt_i, rec_o = cnt_o;  // responses recorded (+self)
           for (int v = 0; v < P; ++v) {
             bool won_before = ((cnt_i >= q_i) || n_i == 0) &&
                               ((cnt_o >= q_o) || n_o == 0);
-            if (snap[c][v] >= 0 && !won_before &&
+            // A loser's later responses are stepped by step_follower and
+            // ignored (poll -> Lost -> become_follower); the triggering
+            // response itself still applies, so the cutoff is a STRICT
+            // prefix (poll runs before maybe_commit_by_vote).
+            bool lost_before =
+                (n_i > 0 && cnt_i + (n_i - rec_i) < q_i) ||
+                (n_o > 0 && cnt_o + (n_o - rec_o) < q_o);
+            if (snap[c][v] >= 0 && !won_before && !lost_before &&
                 snap[c][v] <= grp.agree[c][v] &&
                 snap[c][v] > ps[c].commit)
               ps[c].commit = snap[c][v];
+            bool responded =
+                v != c && (grant_of[v] == c || snap[c][v] >= 0);
+            if (responded) {
+              if (vot(gi, v)) ++rec_i;
+              if (outg(gi, v)) ++rec_o;
+            }
             if (grant_of[v] == c && v != c) {
               // v == c is the self-vote, already in the initial counts
               if (vot(gi, v)) ++cnt_i;
@@ -558,16 +572,12 @@ void mr_read_index(void* h, const uint8_t* crashed, int32_t* out) {
       n_o += e->outg(gi, p) ? 1 : 0;
     }
     bool singleton = (n_i == 1 && n_o == 0);
-    int first_higher = e->P;
-    for (int p = 0; p < e->P; ++p)
-      if (!cr[p] && e->member(gi, p) && ps[p].term > lead_term) {
-        first_higher = p;
-        break;
-      }
+    // Members at a higher term silently IGNORE the lower-term ctx
+    // heartbeat (no check_quorum/pre_vote here): neither ack nor depose.
     int a_i = 0, a_o = 0;
     for (int p = 0; p < e->P; ++p) {
-      bool acks =
-          (p == lead) || (!cr[p] && e->member(gi, p) && p < first_higher);
+      bool acks = (p == lead) ||
+                  (!cr[p] && e->member(gi, p) && ps[p].term <= lead_term);
       if (!acks) continue;
       a_i += e->vot(gi, p) ? 1 : 0;
       a_o += e->outg(gi, p) ? 1 : 0;

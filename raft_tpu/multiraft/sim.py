@@ -404,17 +404,39 @@ def step(
             # committed entry" and "ci holds v's committed entry" are
             # index <= agree[ci, v].
             agree_ci = st.agree[ci]  # [P_v, G]
-            # candidate-side: rejections apply until the grant quorum lands
+            # candidate-side: rejections apply until the election DECIDES in
+            # voter-index response order — a winner's later responses are
+            # stepped by step_leader (ignored; raft.rs:2184-2190), and a
+            # LOSER's later responses are stepped by step_follower (also
+            # ignored: poll -> Lost -> become_follower).  The response that
+            # triggers the loss itself still applies (poll runs before
+            # maybe_commit_by_vote, raft.rs:2236-2247), hence the cutoffs
+            # below are both STRICT prefixes.
             cnt_i = (c_active & st.voter_mask[ci]).astype(jnp.int32)
             cnt_o = (c_active & st.outgoing_mask[ci]).astype(jnp.int32)
+            rec_i = cnt_i  # responses recorded so far (incl. self-vote)
+            rec_o = cnt_o
             ff = jnp.zeros((G,), jnp.int32)
             for v in range(P):
                 won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
                     (cnt_o >= q_o) | (n_o == 0)
                 )
+                lost_before = (
+                    (n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)
+                ) | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
                 snap = commit_run[v]
-                ok = rej_ci[v] & ~won_before & (snap <= agree_ci[v])
+                ok = (
+                    rej_ci[v]
+                    & ~won_before
+                    & ~lost_before
+                    & (snap <= agree_ci[v])
+                )
                 ff = jnp.where(ok, jnp.maximum(ff, snap), ff)
+                resp_v = grants_ci[v] | rej_ci[v]
+                rec_i = rec_i + (resp_v & st.voter_mask[v]).astype(jnp.int32)
+                rec_o = rec_o + (resp_v & st.outgoing_mask[v]).astype(
+                    jnp.int32
+                )
                 cnt_i = cnt_i + (grants_ci[v] & st.voter_mask[v]).astype(
                     jnp.int32
                 )
@@ -644,12 +666,13 @@ def read_index(
       * no alive leader, or
       * the leader has not committed an entry in its own term yet
         (commit < term_start_index — the commit_to_current_term gate), or
-      * the ack quorum fails: heartbeat acks accumulate from alive members
-        in peer-id order, but an alive member at a HIGHER term deposes the
-        leader with its response, so only ackers ordered before the first
-        such peer count (the leader's own ack from add_request always
-        counts).  Joint configs need both majorities; a singleton group
-        answers immediately without heartbeats (raft.rs:2075-2079).
+      * the ack quorum fails: alive members at term <= the leader's ack
+        the ctx heartbeat; members at a HIGHER term silently IGNORE it —
+        with check_quorum and pre_vote both off (this sim's config) a
+        lower-term heartbeat draws no response at all (raft.rs:1299-1330),
+        so they neither ack nor depose.  Joint configs need both
+        majorities; a singleton group answers immediately without
+        heartbeats (raft.rs:2075-2079).
 
     Pure and jittable: probing reads never mutates `st` (the scalar oracle's
     probe DOES perturb its cluster, so parity tests probe last).
@@ -670,10 +693,7 @@ def read_index(
     n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
     singleton = (n_i == 1) & (n_o == 0)
 
-    pos = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
-    higher = alive & member & (st.term > lead_term[None, :])
-    first_higher = jnp.min(jnp.where(higher, pos, P), axis=0)  # [G]
-    acker = (alive & member & (pos < first_higher[None, :])) | acting
+    acker = (alive & member & (st.term <= lead_term[None, :])) | acting
 
     def half_quorum(mask):
         n = jnp.sum(mask, axis=0).astype(jnp.int32)

@@ -199,3 +199,12 @@ def test_read_index_storm_mixed():
             seed, 2, 6, 60,
             voters=[1, 2, 3, 4], outgoing=[3, 4, 5], learners=[6],
         )
+
+
+def test_read_index_higher_term_member_ignores():
+    """Members at a higher term silently ignore the lower-term ctx
+    heartbeat (check_quorum/pre_vote off): they neither ack nor depose, so
+    the rest of the quorum still completes the read.  Seeds 4030/8008
+    historically returned -1 from the batched barrier here."""
+    run_probe_schedule(4030, 3, 4, 200)
+    run_probe_schedule(8008, 2, 5, 160, voters=[1, 2, 3, 4, 5])

@@ -245,3 +245,12 @@ def run_fuzz_config(seed, G, P, rounds, voters, outgoing=None, learners=None):
             assert np.array_equal(
                 want[f].astype(np.int32), nat[f]
             ), f"seed {seed} r{r} NATIVE {f}"
+
+
+def test_fuzz_regression_loss_cutoff():
+    # seed 5001 historically: a candidate that LOSES mid-response-wave
+    # (poll -> Lost -> become_follower) ignores later vote responses, so
+    # their commit hints must not fast-forward it; the triggering response
+    # itself still applies (poll runs before maybe_commit_by_vote).
+    run_fuzz_mixed(5001)
+    run_fuzz_mixed(5002)
