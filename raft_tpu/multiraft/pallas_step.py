@@ -389,8 +389,15 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
         n_storm = jnp.sum(
             ~steady_mask(cfg, st, crashed, horizon=k)
         ).astype(jnp.int32)
+        # Three-way dispatch: the all-steady case takes the PURE fused
+        # kernel (no argsort/gather/sub-batch overhead — the common case
+        # must cost exactly what fast_multi_round costs), sparse storms the
+        # gathered split, mass storms the whole-batch general fallback.
         return jax.lax.cond(
-            n_storm <= S, hybrid, slow, (st, crashed, append_n)
+            n_storm == 0,
+            lambda args: pallas_fn(*args),
+            lambda args: jax.lax.cond(n_storm <= S, hybrid, slow, args),
+            (st, crashed, append_n),
         )
 
     return fn
