@@ -575,16 +575,19 @@ void mr_read_index(void* h, const uint8_t* crashed, int32_t* out) {
     // Members at a higher term silently IGNORE the lower-term ctx
     // heartbeat (no check_quorum/pre_vote here): neither ack nor depose.
     int a_i = 0, a_o = 0;
+    bool any_other = false;  // the quorum check only runs on RECEIVING a
+                             // heartbeat response (raft.rs:1805-1818)
     for (int p = 0; p < e->P; ++p) {
       bool acks = (p == lead) ||
                   (!cr[p] && e->member(gi, p) && ps[p].term <= lead_term);
       if (!acks) continue;
+      if (p != lead) any_other = true;
       a_i += e->vot(gi, p) ? 1 : 0;
       a_o += e->outg(gi, p) ? 1 : 0;
     }
     bool q = (n_i == 0 || a_i >= n_i / 2 + 1) &&
              (n_o == 0 || a_o >= n_o / 2 + 1);
-    if (singleton || q) out[gi] = ps[lead].commit;
+    if (singleton || (q && any_other)) out[gi] = ps[lead].commit;
   }
 }
 

@@ -701,7 +701,14 @@ def read_index(
         return (acks >= n // 2 + 1) | (n == 0)
 
     quorum = half_quorum(st.voter_mask) & half_quorum(st.outgoing_mask)
-    ok = servable & (singleton | quorum)
+    # The ack-quorum is only ever EVALUATED inside
+    # handle_heartbeat_response (raft.rs:1805-1818), so at least one OTHER
+    # alive member must actually respond — a joint config whose quorum is
+    # the leader alone (e.g. incoming == outgoing == {leader}) hangs its
+    # reads until leave-joint, because is_singleton() requires an EMPTY
+    # outgoing half (found by randomized-config fuzz).
+    any_other = jnp.any(acker & ~acting, axis=0)
+    ok = servable & (singleton | (quorum & any_other))
     return jnp.where(ok, lead_commit, jnp.int32(-1))
 
 

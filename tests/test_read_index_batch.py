@@ -208,3 +208,13 @@ def test_read_index_higher_term_member_ignores():
     historically returned -1 from the batched barrier here."""
     run_probe_schedule(4030, 3, 4, 200)
     run_probe_schedule(8008, 2, 5, 160, voters=[1, 2, 3, 4, 5])
+
+
+def test_read_index_joint_self_quorum_hangs():
+    """A joint config whose quorum is the leader alone (incoming ==
+    outgoing == {leader}) is NOT a singleton (outgoing non-empty), so Safe
+    reads go through the ctx-heartbeat path — but the ack quorum is only
+    evaluated on RECEIVING a response, and there are no other members to
+    respond: the read hangs until leave-joint.  Seed 838435 historically
+    returned the commit index from the batched barrier here."""
+    run_probe_schedule(838435, 2, 2, 140, voters=[2], outgoing=[2])
