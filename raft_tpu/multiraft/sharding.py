@@ -27,12 +27,16 @@ from .kernels import ROLE_LEADER
 from .sim import SimConfig, SimState
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "groups") -> Mesh:
-    """1-D device mesh over the group axis."""
-    devices = jax.devices()
+def make_mesh(
+    n_devices: Optional[int] = None, axis: str = "groups", devices=None
+) -> Mesh:
+    """1-D device mesh over the group axis.  Pass `devices` explicitly to
+    pin the backend (e.g. jax.devices("cpu") for a virtual dryrun mesh)."""
+    if devices is None:
+        devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return jax.make_mesh((len(devices),), (axis,), devices=devices)
+    return jax.make_mesh((len(devices),), (axis,), devices=list(devices))
 
 
 def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:

@@ -1,0 +1,41 @@
+"""Platform selection helpers.
+
+JAX picks its backend once per process; tests and the multichip dryrun both
+need a *virtual CPU* mesh (N host devices) regardless of what the ambient
+environment points at (the shell under the driver pins JAX_PLATFORMS at the
+real TPU tunnel).  This is the single copy of that forcing recipe — call it
+before anything initializes a backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Pin this process to the CPU platform with `n_devices` virtual devices.
+
+    Mutates process-global state (env vars + jax.config) and does NOT restore
+    it: the caller owns the whole process (pytest session, driver dryrun
+    subprocess).  Do not call from a process that later needs the real TPU.
+
+    Env vars cover the fresh-process case; jax.config covers jax already
+    being imported (e.g. a sitecustomize pre-import) with no live backend.
+    If a CPU backend is already initialized the config updates raise
+    RuntimeError, which we swallow — callers must check jax.devices("cpu")
+    if they need a hard guarantee.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError:
+        pass  # backend already initialized; caller checks jax.devices("cpu")

@@ -5,18 +5,17 @@ MUST be set before jax is imported anywhere in the test process.
 """
 
 import os
+import sys
 
-# Force CPU for the test suite (the shell points JAX_PLATFORMS at the real
-# TPU and a sitecustomize pre-imports jax, so we must go through jax.config
-# rather than the environment).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices("cpu")) >= 8 and jax.default_backend() == "cpu", (
+    "test suite needs a virtual 8-device CPU backend but one was already "
+    f"initialized: {jax.default_backend()} x{len(jax.devices())}"
+)
