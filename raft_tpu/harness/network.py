@@ -7,6 +7,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..config import Config
 from ..eraftpb import ConfState, Message, MessageType
+from ..errors import RaftError
 from ..raft import Raft
 from ..raft_log import NO_LIMIT
 from ..storage import MemStorage
@@ -96,9 +97,13 @@ class Network:
             new_msgs: List[Message] = []
             for m in msgs:
                 p = self.peers[m.to]
+                # Only protocol-level step errors are ignored, exactly like
+                # the reference's `let _ = self.raft.step(m)` (reference:
+                # harness/src/interface.rs:41-46); anything else (assertion,
+                # type error) is a harness-caught bug and must propagate.
                 try:
                     p.step(m)
-                except Exception:
+                except RaftError:
                     pass
                 p.persist()
                 new_msgs.extend(self.filter(p.read_messages()))

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..eraftpb import Message, MessageType
+from ..errors import RaftError
 from ..raft import StateRole, new_message
 from ..raw_node import RawNode
 from ..storage import Storage
@@ -121,11 +122,14 @@ class MultiRaft:
             node = self.nodes[g]
             r = node.raft
             self._sync_to_node(g)
+            # Tick side effects drop only protocol-level step errors, like
+            # Raft.tick's internal `let _ = self.step(...)` (reference:
+            # raft.rs:1037-1047); real bugs (assertions etc.) propagate.
             if campaign[g]:
                 # tick_election fired (reference: raft.rs:1037-1047).
                 try:
                     r.step(new_message(0, MessageType.MsgHup, r.id))
-                except Exception:
+                except RaftError:
                     pass
             if checkq[g]:
                 # Leader election-timeout boundary (reference:
@@ -133,14 +137,14 @@ class MultiRaft:
                 if r.check_quorum:
                     try:
                         r.step(new_message(0, MessageType.MsgCheckQuorum, r.id))
-                    except Exception:
+                    except RaftError:
                         pass
                 if r.state == StateRole.Leader and r.lead_transferee is not None:
                     r.abort_leader_transfer()
             if beat[g] and r.state == StateRole.Leader:
                 try:
                     r.step(new_message(0, MessageType.MsgBeat, r.id))
-                except Exception:
+                except RaftError:
                     pass
             self._sync_from_node(g)
         return active
@@ -166,9 +170,11 @@ class MultiRaft:
         for g in sorted(by_group):
             self._sync_to_node(g)
             for m in by_group[g]:
+                # Inbox delivery ignores protocol step errors only (the DCN
+                # receive path mirrors the harness pump's discipline).
                 try:
                     self.nodes[g].step(m)
-                except Exception:
+                except RaftError:
                     pass
             self._sync_from_node(g)
 

@@ -27,10 +27,15 @@ def force_virtual_cpu(n_devices: int) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    # Replace any pre-existing device-count flag (whatever its value) rather
+    # than skipping: a stale count would silently survive into the backend.
+    kept = [
+        f
+        for f in flags.split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
 
     import jax
 
@@ -39,3 +44,28 @@ def force_virtual_cpu(n_devices: int) -> None:
         jax.config.update("jax_num_cpu_devices", n_devices)
     except RuntimeError:
         pass  # backend already initialized; caller checks jax.devices("cpu")
+
+
+def require_virtual_cpu(n_devices: int) -> list:
+    """Hard guarantee that the live backend is CPU with >= n_devices virtual
+    devices; returns the device list.  Raises one actionable RuntimeError for
+    both failure modes (non-CPU backend already initialized, or too few
+    virtual devices) instead of jax's opaque 'unknown backend'."""
+    import jax
+
+    try:
+        devices = jax.devices("cpu")
+        backend = jax.default_backend()
+    except RuntimeError as e:
+        raise RuntimeError(
+            "a non-CPU backend was already initialized in this process; "
+            "call force_virtual_cpu() before any jax backend use, or run "
+            "in a fresh process."
+        ) from e
+    if len(devices) < n_devices or backend != "cpu":
+        raise RuntimeError(
+            f"need a virtual {n_devices}-device CPU backend but got "
+            f"{backend} x{len(devices)}; call force_virtual_cpu() before "
+            "any jax backend use, or run in a fresh process."
+        )
+    return devices

@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 from typing import Deque, List, Optional, Tuple, Union
 
 from .config import Config
-from .errors import StepLocalMsg, StepPeerNotFound
+from .errors import RaftError, StepLocalMsg, StepPeerNotFound
 from .eraftpb import (
     ConfChange,
     ConfChangeV2,
@@ -407,7 +407,7 @@ class RawNode:
         """reference: raw_node.rs:692-698"""
         try:
             self.raft.step(Message(msg_type=MessageType.MsgUnreachable, from_=id))
-        except Exception:
+        except RaftError:
             pass
 
     def report_snapshot(self, id: int, status: int) -> None:
@@ -417,7 +417,7 @@ class RawNode:
             self.raft.step(
                 Message(msg_type=MessageType.MsgSnapStatus, from_=id, reject=rej)
             )
-        except Exception:
+        except RaftError:
             pass
 
     def request_snapshot(self, request_index: int) -> None:
@@ -430,7 +430,7 @@ class RawNode:
             self.raft.step(
                 Message(msg_type=MessageType.MsgTransferLeader, from_=transferee)
             )
-        except Exception:
+        except RaftError:
             pass
 
     def read_index(self, rctx: bytes) -> None:
@@ -442,7 +442,7 @@ class RawNode:
                     entries=[Entry(data=rctx)],
                 )
             )
-        except Exception:
+        except RaftError:
             pass
 
     @property

@@ -9,13 +9,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from raft_tpu.platform import force_virtual_cpu  # noqa: E402
+from raft_tpu.platform import force_virtual_cpu, require_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)
-
-import jax  # noqa: E402
-
-assert len(jax.devices("cpu")) >= 8 and jax.default_backend() == "cpu", (
-    "test suite needs a virtual 8-device CPU backend but one was already "
-    f"initialized: {jax.default_backend()} x{len(jax.devices())}"
-)
+require_virtual_cpu(8)

@@ -1,0 +1,67 @@
+"""The harness pump ignores ONLY protocol-level step errors, mirroring the
+reference's `let _ = self.raft.step(m)` (reference: harness/src/interface.rs:
+41-46).  A genuine bug inside `step` — an assertion, a type error — must
+propagate and fail the suite, not be silently eaten by the machinery meant
+to catch it."""
+
+import pytest
+
+from raft_tpu.eraftpb import Message, MessageType
+from raft_tpu.errors import StepPeerNotFound
+from raft_tpu.harness import Interface, Network
+from raft_tpu.multiraft.driver import MultiRaft
+from raft_tpu.config import Config
+from raft_tpu.eraftpb import ConfState
+from raft_tpu.storage import MemStorage
+
+
+def _beat(net: Network) -> None:
+    net.send(
+        [Message(msg_type=MessageType.MsgBeat, from_=1, to=1)]
+    )
+
+
+def test_injected_assertion_propagates_through_pump():
+    net = Network.new([None, None, None])
+    net.send([Message(msg_type=MessageType.MsgHup, from_=1, to=1)])
+
+    orig_step = net.peers[2].raft.step
+
+    def bad_step(m):
+        orig_step(m)
+        raise AssertionError("injected bug inside step")
+
+    net.peers[2].raft.step = bad_step
+    with pytest.raises(AssertionError, match="injected bug"):
+        _beat(net)
+
+
+def test_raft_error_still_ignored_by_pump():
+    net = Network.new([None, None, None])
+    net.send([Message(msg_type=MessageType.MsgHup, from_=1, to=1)])
+
+    orig_step = net.peers[2].raft.step
+
+    def flaky_step(m):
+        orig_step(m)
+        raise StepPeerNotFound()
+
+    net.peers[2].raft.step = flaky_step
+    _beat(net)  # no raise: protocol errors are dropped like the reference
+
+
+def test_injected_assertion_propagates_through_multiraft_inbox():
+    cs = ConfState(voters=[1])
+    store = MemStorage.new_with_conf_state(cs)
+    cfg = Config(id=1, election_tick=10, heartbeat_tick=1)
+    mr = MultiRaft(cfg, [store])
+    mr.campaign(0)
+
+    def bad(m):
+        raise AssertionError("injected bug inside step")
+
+    mr.nodes[0].step = bad
+    with pytest.raises(AssertionError, match="injected bug"):
+        mr.step_batch(
+            [(0, Message(msg_type=MessageType.MsgBeat, from_=1, to=1))]
+        )
