@@ -28,10 +28,13 @@ from ..harness import Interface, Network
 class ScalarCluster:
     def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
                  heartbeat_tick: int = 1, voters=None, voters_outgoing=None,
-                 learners=None):
+                 learners=None, check_quorum: bool = False,
+                 pre_vote: bool = False):
         """`voters`/`voters_outgoing`/`learners` (peer-id lists) bootstrap
         every group in that (possibly joint) configuration; default: all
-        peers voters."""
+        peers voters.  `check_quorum`/`pre_vote` configure every Raft the
+        reference way (raft.rs Config), making this the oracle for the
+        device sim's same-named SimConfig flags."""
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.networks: List[Network] = []
@@ -42,6 +45,8 @@ class ScalarCluster:
                 max_size_per_msg=NO_LIMIT,
                 max_inflight_msgs=1 << 20,  # effectively unbounded window
                 timeout_seed=g,
+                check_quorum=check_quorum,
+                pre_vote=pre_vote,
             )
             if voters is None:
                 peers: List[Optional[Interface]] = [None] * n_peers
