@@ -53,6 +53,7 @@ from .eraftpb import (
     conf_state_eq,
 )
 from .log_unstable import Unstable
+from .metrics import EventTracer, Metrics, Registry
 from .quorum import JointConfig, MajorityConfig, VoteResult
 from .raft import (
     CAMPAIGN_ELECTION,
@@ -88,6 +89,18 @@ __version__ = "0.1.0"
 
 # The "prelude" of the reference (reference: lib.rs:543-570).
 __all__ = [
+    "Compacted",
+    "ConfChangeError",
+    "ConfigInvalid",
+    "ProposalDropped",
+    "RaftError",
+    "RequestSnapshotDropped",
+    "SnapshotOutOfDate",
+    "SnapshotTemporarilyUnavailable",
+    "StepLocalMsg",
+    "StepPeerNotFound",
+    "StorageError",
+    "Unavailable",
     "Config",
     "ConfChange",
     "ConfChangeV2",
@@ -114,6 +127,9 @@ __all__ = [
     "MemStorageCore",
     "RaftState",
     "Unstable",
+    "Metrics",
+    "Registry",
+    "EventTracer",
     "ProgressTracker",
     "Progress",
     "ProgressState",

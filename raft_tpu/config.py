@@ -8,6 +8,7 @@ also accepts per-group *arrays* of tick bounds (see raft_tpu.multiraft).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 from .errors import ConfigInvalid
 from .read_only_option import ReadOnlyOption
@@ -63,6 +64,12 @@ class Config:
     # share peer ids 1..P (the MultiRaft batch) draw independent timeout
     # streams while staying bit-identical to the device kernel.
     timeout_seed: int = 0
+    # raft-tpu extension: observability plane (raft_tpu.metrics.Metrics).
+    # None (the default) disables all instrumentation; every hook in the hot
+    # path is guarded by a single `is not None` branch.  A deployment shares
+    # ONE instance across its nodes/groups — counters aggregate, trace
+    # events stay tagged per (group, id).
+    metrics: Optional["object"] = None
 
     def min_election_tick_or_default(self) -> int:
         """reference: config.rs:129-136"""

@@ -6,7 +6,7 @@ This module is the Python-side equivalent of the reference's protobuf schema
 written against raft-rs can map its transport 1:1, but the in-memory
 representation is plain dataclasses: the consensus core never serializes, and
 the batched MultiRaft device path uses dense struct-of-arrays tensors instead
-of per-message objects (see raft_tpu.multiraft.state).
+of per-message objects (see raft_tpu.multiraft.sim.SimState).
 
 Zero-valued fields mean "absent", matching proto3 semantics the reference
 relies on (e.g. `vote == 0` means "voted for nobody", INVALID_ID).

@@ -25,7 +25,9 @@ class Interface:
         return getattr(raft, name)
 
     def step(self, m: Message) -> None:
-        """reference: interface.rs:41-46"""
+        """Forward one message to the wrapped raft; a None raft black-holes
+        it.  (The reference has no Interface::step — Deref forwards to Raft,
+        and the harness pump steps peers at harness/src/network.rs:169.)"""
         if self.raft is not None:
             self.raft.step(m)
 

@@ -98,8 +98,8 @@ class Network:
             for m in msgs:
                 p = self.peers[m.to]
                 # Only protocol-level step errors are ignored, exactly like
-                # the reference's `let _ = self.raft.step(m)` (reference:
-                # harness/src/interface.rs:41-46); anything else (assertion,
+                # the reference's `let _ = p.step(m)` (reference:
+                # harness/src/network.rs:169); anything else (assertion,
                 # type error) is a harness-caught bug and must propagate.
                 try:
                     p.step(m)

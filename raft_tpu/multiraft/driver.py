@@ -22,6 +22,7 @@ raft.rs:1056-1065).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -56,6 +57,10 @@ class MultiRaft:
             self.nodes.append(RawNode(cfg, store))
         self.election_tick = base_config.election_tick
         self.heartbeat_tick = base_config.heartbeat_tick
+        # Shared observability plane: the per-group Config copies above all
+        # carry the same Metrics reference, so every scalar node reports
+        # into one registry; the driver adds its own multiraft_* series.
+        self.metrics = base_config.metrics
 
         # Host-side mirrors [G] (authoritative between host events).
         self._state = np.array([n.raft.state for n in self.nodes], np.int32)
@@ -101,6 +106,8 @@ class MultiRaft:
         """Advance every group's logical clock by one tick with a single
         fused device kernel; dispatch tick side effects on the host only for
         fired groups.  Returns the boolean [G] mask of active groups."""
+        m = self.metrics
+        t0 = time.perf_counter() if m is not None else 0.0
         ee, hb, campaign, beat, checkq = self._tick_fn(
             jnp.asarray(self._state),
             jnp.asarray(self._ee),
@@ -115,6 +122,16 @@ class MultiRaft:
         beat = np.asarray(beat)
         checkq = np.asarray(checkq)
         active = campaign | beat | checkq
+        if m is not None:
+            # The np conversions above block on the device, so t0..now spans
+            # the full upload -> kernel -> download round trip.
+            m.on_driver_tick(
+                n_active=int(active.sum()),
+                n_campaign=int(campaign.sum()),
+                n_beat=int(beat.sum()),
+                n_checkq=int(checkq.sum()),
+                sync_seconds=time.perf_counter() - t0,
+            )
         if not active.any():
             return active
         for g in np.nonzero(active)[0]:
@@ -204,13 +221,13 @@ class MultiRaft:
 
     # --- batched introspection (SURVEY.md §5.5 MultiRaftStatus) ---
 
-    def status(self) -> Dict[str, int]:
+    def status(self) -> Dict[str, object]:
         states = self._state
         commits = np.array(
             [n.raft.raft_log.committed for n in self.nodes], np.int64
         )
         terms = np.array([n.raft.term for n in self.nodes], np.int64)
-        return {
+        out: Dict[str, object] = {
             "n_groups": self.G,
             "n_leaders": int((states == StateRole.Leader).sum()),
             "n_candidates": int((states == StateRole.Candidate).sum()),
@@ -218,3 +235,14 @@ class MultiRaft:
             "total_commit": int(commits.sum()),
             "max_term": int(terms.max()) if self.G else 0,
         }
+        if self.metrics is not None:
+            out["metrics"] = self.metrics_snapshot()
+        return out
+
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """Flat {sample_name: value} view of the shared registry (empty when
+        metrics are disabled); `self.metrics.registry.expose()` gives the
+        Prometheus text form."""
+        if self.metrics is None:
+            return {}
+        return self.metrics.registry.snapshot()

@@ -218,6 +218,56 @@ ROLE_LEADER = 2
 ROLE_PRE_CANDIDATE = 3
 
 
+# --- device-side event-counter plane (the batched observability layer) ---
+#
+# Indices into the [N_COUNTERS] int32 accumulator that `sim.step` sums when
+# given a `counters` array: the device-resident mirror of the scalar
+# metrics counters (raft_tpu.metrics), accumulated inside the jitted step so
+# the hot loop's dispatch count is unchanged and downloaded only on demand
+# (ClusterSim.counters()).  Parity against the scalar oracle's counts is
+# asserted by tests/test_counter_parity.py.
+CTR_CAMPAIGNS = 0  # election timers fired (scalar: Raft.campaign calls)
+CTR_HEARTBEATS = 1  # leader heartbeat timers fired (scalar: MsgBeat steps)
+CTR_ELECTIONS_WON = 2  # leaders elected (scalar: become_leader calls)
+CTR_COMMIT_ENTRIES = 3  # sum of per-peer commit-index advances
+N_COUNTERS = 4
+
+COUNTER_NAMES = (
+    "campaigns",
+    "heartbeats",
+    "elections_won",
+    "commit_entries",
+)
+
+
+def zero_counters() -> jnp.ndarray:
+    """Fresh [N_COUNTERS] int32 accumulator plane."""
+    return jnp.zeros((N_COUNTERS,), jnp.int32)
+
+
+def count_events(
+    counters: jnp.ndarray,
+    want_campaign: jnp.ndarray,
+    want_heartbeat: jnp.ndarray,
+    won: jnp.ndarray,
+    commit_delta: jnp.ndarray,
+) -> jnp.ndarray:
+    """Fold one round's event masks into the accumulator plane.
+
+    want_campaign/want_heartbeat/won: bool planes (any shape); commit_delta:
+    int32 plane of per-peer commit-index increases this round.
+    """
+    events = jnp.stack(
+        [
+            jnp.sum(want_campaign.astype(jnp.int32)),
+            jnp.sum(want_heartbeat.astype(jnp.int32)),
+            jnp.sum(won.astype(jnp.int32)),
+            jnp.sum(commit_delta),
+        ]
+    ).astype(counters.dtype)
+    return counters + events
+
+
 def tick_kernel(
     state: jnp.ndarray,
     election_elapsed: jnp.ndarray,

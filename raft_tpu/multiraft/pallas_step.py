@@ -8,12 +8,14 @@ these kernels stream each [P, BLOCK] tile through VMEM once and run **k
 whole protocol rounds** on it before writing back, amortizing both HBM
 traffic and per-block overhead over k rounds.
 
-Measured on v5e-1 at 100k groups × 5 peers (steady append load):
-
-    general XLA step (sim.step)     ~300M ticks/s
-    this kernel, k = 1              ~240M ticks/s   (XLA fusion wins)
-    this kernel, k = 16..32        ~1.40B ticks/s   (~4.7x the XLA step,
-                                                     ~90x the native C++ engine)
+Relative shape measured on v5e-1 at 100k groups × 5 peers (steady append
+load): at k = 1 the kernel loses to the general XLA step (fusion wins);
+at k = 16..32 it is a multiple of the XLA step's throughput.  Absolute
+ticks/s on the shared-tunnel TPU varied >2x between measurement windows
+(410M-855M across bench rounds), so no single number is quoted here —
+current figures come from `python bench.py`, which reports
+min/median/max/spread_pct over >=5 repetitions and flags spreads >20%
+(see docs/OBSERVABILITY.md).
 
 `steady_predicate(cfg, st, crashed, horizon=k)` decides whether the
 invariant provably holds for the next k rounds; `fast_multi_round` then

@@ -90,7 +90,10 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
       max_term:    maximum term across groups
       total_commit: sum of per-group leader commit indices
     """
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps shard_map under experimental
+        from jax.experimental.shard_map import shard_map
 
     state_specs = jax.tree.map(
         lambda s: s.spec, state_sharding(mesh, axis)

@@ -44,7 +44,7 @@ tests/test_sim_fuzz.py for the schedules that originally exposed them.
 from __future__ import annotations
 
 import functools
-from typing import List, NamedTuple, Optional
+from typing import List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,12 @@ class SimConfig(NamedTuple):
     n_peers: int
     election_tick: int = 10
     heartbeat_tick: int = 1
+    # Observability toggle: when True, ClusterSim carries the device-side
+    # [kernels.N_COUNTERS] int32 event-counter plane, summed INSIDE the
+    # jitted step (one dispatch either way) and downloaded only on demand
+    # via ClusterSim.counters().  Compile-time static: the disabled graph is
+    # bit-identical to pre-observability builds.
+    collect_counters: bool = False
 
     @property
     def min_timeout(self) -> int:
@@ -211,13 +217,19 @@ def step(
     crashed: jnp.ndarray,
     append_n: jnp.ndarray,
     group_ids: Optional[jnp.ndarray] = None,
-) -> SimState:
+    counters: Optional[jnp.ndarray] = None,
+) -> Union[SimState, Tuple[SimState, jnp.ndarray]]:
     """One lockstep protocol round for every group.
 
     crashed:  bool[P, G] peers isolated this round (keep ticking, no I/O)
     append_n: int32[G]   entries proposed at the group's leader this round
     group_ids: optional int32[G] global group ids when st is a gathered
                sub-batch (keeps the per-(group, term) timeout PRNG global)
+    counters: optional [kernels.N_COUNTERS] int32 accumulator plane; when
+               given, this round's event counts (campaigns, heartbeats,
+               elections won, commit entries) are folded in on-device and
+               the return value becomes (state, counters).  The choice is
+               trace-time static: the counters=None graph is unchanged.
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -634,7 +646,7 @@ def step(
     # outran a stale leader are kept.
     commit = jnp.where(sync, jnp.maximum(commit, lead_commit), commit)
 
-    return SimState(
+    out = SimState(
         term=term_d,
         state=state_d,
         vote=vote_d,
@@ -652,6 +664,17 @@ def step(
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
     )
+    if counters is None:
+        return out
+    # Device-side event counting, fused into this same dispatch.  A group
+    # wins at most one election per round (quorum uniqueness), and the solo
+    # crashed-campaigner path is mutually exclusive with the networked one,
+    # so `winner_exists | any(solo_win)` is exactly the become_leader count.
+    won_any = winner_exists | jnp.any(solo_win, axis=0)
+    counters = kernels.count_events(
+        counters, want_campaign, want_heartbeat, won_any, commit - st.commit
+    )
+    return out, counters
 
 
 def read_index(
@@ -678,7 +701,6 @@ def read_index(
     probe DOES perturb its cluster, so parity tests probe last).
     Returns int32[G].
     """
-    P = cfg.n_peers
     alive = ~crashed
     member = st.voter_mask | st.outgoing_mask | st.learner_mask
     is_lead = (st.state == ROLE_LEADER) & alive
@@ -726,6 +748,56 @@ class ClusterSim:
         self.cfg = cfg
         self.state = init_state(cfg, voter_mask, outgoing_mask, learner_mask)
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
+        self._counters: Optional[jnp.ndarray] = None
+        self._step_counted = None
+        if cfg.collect_counters:
+            self._counters = kernels.zero_counters()
+            # The device plane is int32 (TPUs have no native int64), so on
+            # long runs it is periodically drained into this unbounded
+            # host-side accumulator: one device_get every _drain_every
+            # rounds keeps the in-flight window far below 2**31 events
+            # while leaving per-round dispatch untouched.  Event rates are
+            # caller-controlled (append_n) and unknown here, so the cadence
+            # starts at 1 round and grows toward a G-scaled cap only while
+            # observed windows stay far below the int32 range (halving back
+            # under pressure).  The one undetectable case left is a single
+            # round accruing >= 2**31 events — a rate at which the int32
+            # SimState.commit plane itself would overflow within the run.
+            self._host_counters = [0] * kernels.N_COUNTERS
+            self._rounds_since_drain = 0
+            self._drain_every = 1
+            self._drain_cap = max(
+                1, min(self._DRAIN_MAX, (1 << 31) // (256 * cfg.n_groups))
+            )
+
+            def _counted(st, crashed, append_n, ctrs):
+                return step(cfg, st, crashed, append_n, counters=ctrs)
+
+            self._step_counted = jax.jit(_counted, donate_argnums=(0, 3))
+
+    _DRAIN_MAX = 128  # never let a window exceed this many rounds
+
+    def _drain_counters(self) -> None:
+        vals = jax.device_get(self._counters)
+        peak = 0
+        for i in range(kernels.N_COUNTERS):
+            v = int(vals[i])
+            if v < 0:
+                raise RuntimeError(
+                    "device event counter wrapped int32 within one drain "
+                    "window; totals are corrupt — rerun with more frequent "
+                    "ClusterSim.counters() calls or fewer events per round"
+                )
+            peak = max(peak, v)
+            self._host_counters[i] += v
+        # Adapt the cadence to the observed event rate: stay well clear of
+        # 2**31 per window, but don't sync more often than needed.
+        if peak > (1 << 29) and self._drain_every > 1:
+            self._drain_every //= 2
+        elif peak < (1 << 26) and self._drain_every < self._drain_cap:
+            self._drain_every *= 2
+        self._counters = kernels.zero_counters()
+        self._rounds_since_drain = 0
 
     def run_round(self, crashed=None, append_n=None) -> SimState:
         G, P = self.cfg.n_groups, self.cfg.n_peers
@@ -733,13 +805,44 @@ class ClusterSim:
             crashed = jnp.zeros((P, G), bool)
         if append_n is None:
             append_n = jnp.zeros((G,), jnp.int32)
-        self.state = self._step(self.state, crashed, append_n)
+        if self._step_counted is not None:
+            self.state, self._counters = self._step_counted(
+                self.state, crashed, append_n, self._counters
+            )
+            self._rounds_since_drain += 1
+            if self._rounds_since_drain >= self._drain_every:
+                self._drain_counters()
+        else:
+            self.state = self._step(self.state, crashed, append_n)
         return self.state
 
     def run(self, rounds: int, crashed=None, append_n=None) -> SimState:
         for _ in range(rounds):
             self.run_round(crashed, append_n)
         return self.state
+
+    def counters(self) -> dict:
+        """Download the device event-counter plane as {name: count}.
+
+        The device->host transfer happens HERE, on demand — never in the
+        hot loop.  Requires SimConfig(collect_counters=True).
+        """
+        if self._counters is None:
+            raise RuntimeError(
+                "counters disabled; construct with "
+                "SimConfig(collect_counters=True)"
+            )
+        # Fold the device plane into the host totals (running the wrap
+        # check) rather than just peeking at it, so every user-visible read
+        # is both exact and validated.
+        self._drain_counters()
+        return dict(zip(kernels.COUNTER_NAMES, self._host_counters))
+
+    def reset_counters(self) -> None:
+        if self._counters is not None:
+            self._counters = kernels.zero_counters()
+            self._host_counters = [0] * kernels.N_COUNTERS
+            self._rounds_since_drain = 0
 
     def read_index(self, crashed=None) -> jnp.ndarray:
         """Batched linearizable ReadIndex barrier (see sim.read_index)."""

@@ -29,12 +29,15 @@ class ScalarCluster:
     def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
                  heartbeat_tick: int = 1, voters=None, voters_outgoing=None,
                  learners=None, check_quorum: bool = False,
-                 pre_vote: bool = False):
+                 pre_vote: bool = False, metrics=None):
         """`voters`/`voters_outgoing`/`learners` (peer-id lists) bootstrap
         every group in that (possibly joint) configuration; default: all
         peers voters.  `check_quorum`/`pre_vote` configure every Raft the
-        reference way (raft.rs Config), making this the oracle for the
-        device sim's same-named SimConfig flags."""
+        reference way (raft.rs Config); the device sim models neither (the
+        host path handles them — see sim.py's protocol-scope note), so
+        parity schedules leave both False.  `metrics` (an optional
+        raft_tpu.metrics.Metrics) is shared by every Raft in the cluster —
+        the scalar side of the device counter-plane parity test."""
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.networks: List[Network] = []
@@ -47,6 +50,7 @@ class ScalarCluster:
                 timeout_seed=g,
                 check_quorum=check_quorum,
                 pre_vote=pre_vote,
+                metrics=metrics,
             )
             if voters is None:
                 peers: List[Optional[Interface]] = [None] * n_peers

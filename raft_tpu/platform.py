@@ -44,6 +44,11 @@ def force_virtual_cpu(n_devices: int) -> None:
         jax.config.update("jax_num_cpu_devices", n_devices)
     except RuntimeError:
         pass  # backend already initialized; caller checks jax.devices("cpu")
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS device
+        # count set above is the only mechanism there and suffices as long
+        # as no backend was initialized before this call.
+        pass
 
 
 def require_virtual_cpu(n_devices: int) -> list:

@@ -19,6 +19,7 @@ Usage:
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from typing import Dict, List
 
@@ -56,6 +57,12 @@ class RoundTimer:
         finally:
             self.samples.append(time.perf_counter() - t0)
 
+    @staticmethod
+    def _percentile(xs: List[float], q: float) -> float:
+        """Nearest-rank percentile (the smallest sample with at least q of
+        the distribution at or below it): xs sorted, 0 < q <= 1."""
+        return xs[math.ceil(q * len(xs)) - 1]
+
     def summary(self) -> Dict[str, float]:
         if not self.samples:
             return {"count": 0}
@@ -64,7 +71,8 @@ class RoundTimer:
         return {
             "count": n,
             "mean_ms": sum(xs) / n * 1e3,
-            "p50_ms": xs[n // 2] * 1e3,
-            "p99_ms": xs[min(n - 1, int(n * 0.99))] * 1e3,
+            "p50_ms": self._percentile(xs, 0.50) * 1e3,
+            "p90_ms": self._percentile(xs, 0.90) * 1e3,
+            "p99_ms": self._percentile(xs, 0.99) * 1e3,
             "max_ms": xs[-1] * 1e3,
         }

@@ -179,6 +179,14 @@ class Raft:
         self.max_committed_size_per_ready = c.max_committed_size_per_ready
         # Counter-based timeout PRNG key (see util.deterministic_timeout).
         self._timeout_key = c.timeout_seed * (1 << 16) + c.id
+        # Observability plane (raft_tpu.metrics.Metrics) or None; every hook
+        # below is guarded by one `is not None` branch so the disabled path
+        # stays free.  timeout_seed doubles as the group tag (the MultiRaft
+        # driver's per-group convention).
+        self.metrics = c.metrics
+        self._group = c.timeout_seed
+        if self.metrics is not None:
+            self.raft_log.on_commit_advance = self._on_commit_advance
 
         self.prs = ProgressTracker(c.max_inflight_msgs)
         self.msgs: List[Message] = []
@@ -193,6 +201,10 @@ class Raft:
         if c.applied > 0:
             self.commit_apply(c.applied)
         self.become_follower(self.term, INVALID_ID)
+
+    def _on_commit_advance(self, old: int, new: int) -> None:
+        """RaftLog.commit_to observability callback (metrics enabled only)."""
+        self.metrics.on_commit_advance(self._group, self.id, self.term, old, new)
 
     # --- accessors (reference: raft.rs:402-598) ---
 
@@ -319,6 +331,8 @@ class Raft:
             MessageType.MsgRequestPreVote,
         ):
             m.priority = self.priority
+        if self.metrics is not None:
+            self.metrics.on_send(m.msg_type)
         self.msgs.append(m)
 
     def _prepare_send_snapshot(self, m: Message, pr, to: int) -> bool:
@@ -334,6 +348,10 @@ class Raft:
             raise AssertionError("need non-empty snapshot")
         m.snapshot = snapshot
         pr.become_snapshot(snapshot.metadata.index)
+        if self.metrics is not None:
+            self.metrics.on_snapshot_sent(
+                self._group, self.id, to, snapshot.metadata.index
+            )
         return True
 
     def _prepare_send_entries(
@@ -589,6 +607,10 @@ class Raft:
         self.leader_id = leader_id
         self.state = StateRole.Follower
         self.pending_request_snapshot = pending_request_snapshot
+        if self.metrics is not None:
+            self.metrics.on_transition(
+                self.state, self._group, self.id, self.term
+            )
 
     def become_candidate(self) -> None:
         """reference: raft.rs:1101-1117"""
@@ -598,6 +620,10 @@ class Raft:
         self.reset(self.term + 1)
         self.vote = self.id
         self.state = StateRole.Candidate
+        if self.metrics is not None:
+            self.metrics.on_transition(
+                self.state, self._group, self.id, self.term
+            )
 
     def become_pre_candidate(self) -> None:
         """Pre-candidate changes only the role: term/vote stay untouched
@@ -608,6 +634,10 @@ class Raft:
         self.state = StateRole.PreCandidate
         self.prs.reset_votes()
         self.leader_id = INVALID_ID
+        if self.metrics is not None:
+            self.metrics.on_transition(
+                self.state, self._group, self.id, self.term
+            )
 
     def become_leader(self) -> None:
         """reference: raft.rs:1151-1202"""
@@ -617,6 +647,11 @@ class Raft:
         self.reset(self.term)
         self.leader_id = self.id
         self.state = StateRole.Leader
+        if self.metrics is not None:
+            self.metrics.on_transition(
+                self.state, self._group, self.id, self.term
+            )
+            self.metrics.on_election_won(self._group, self.id, self.term)
 
         last_index = self.raft_log.last_index()
         # Logs can't change while (pre)candidate and must be persisted before
@@ -643,8 +678,21 @@ class Raft:
             in (EntryType.EntryConfChange, EntryType.EntryConfChangeV2)
         )
 
+    _CAMPAIGN_KINDS = {
+        CAMPAIGN_PRE_ELECTION: "PreElection",
+        CAMPAIGN_ELECTION: "Election",
+        CAMPAIGN_TRANSFER: "Transfer",
+    }
+
     def campaign(self, campaign_type: bytes) -> None:
         """Start an election round (reference: raft.rs:1217-1263)."""
+        if self.metrics is not None:
+            self.metrics.on_campaign(
+                self._CAMPAIGN_KINDS[campaign_type],
+                self._group,
+                self.id,
+                self.term,
+            )
         if campaign_type == CAMPAIGN_PRE_ELECTION:
             self.become_pre_candidate()
             vote_msg = MessageType.MsgRequestPreVote
@@ -676,6 +724,8 @@ class Raft:
 
     def step(self, m: Message) -> None:
         """Advance the state machine with one inbound message."""
+        if self.metrics is not None:
+            self.metrics.on_recv(m.msg_type)
         # Term epoch handling: may step us down to follower.
         if m.term == 0:
             pass  # local message
@@ -763,6 +813,14 @@ class Raft:
                 to_send.reject = False
                 to_send.term = m.term
                 self.send(to_send)
+                if self.metrics is not None:
+                    self.metrics.on_vote_grant(
+                        m.msg_type == MessageType.MsgRequestPreVote,
+                        self._group,
+                        self.id,
+                        self.term,
+                        m.from_,
+                    )
                 if m.msg_type == MessageType.MsgRequestVote:
                     # Only real votes are recorded.
                     self.election_elapsed = 0
@@ -944,6 +1002,8 @@ class Raft:
         """reference: raft.rs:1956-2123"""
         # Messages that need no per-peer progress:
         if m.msg_type == MessageType.MsgBeat:
+            if self.metrics is not None:
+                self.metrics.on_beat()
             self.bcast_heartbeat()
             return
         if m.msg_type == MessageType.MsgCheckQuorum:
@@ -1179,6 +1239,10 @@ class Raft:
             to_send.reject = True
             to_send.reject_hint = hint_index
             to_send.log_term = hint_term
+            if self.metrics is not None:
+                self.metrics.on_append_rejected(
+                    self._group, self.id, self.term, m.index
+                )
         to_send.commit = self.raft_log.committed
         self.send(to_send)
 
@@ -1312,6 +1376,8 @@ class Raft:
             else:
                 cfg, changes = changer.simple(cc.changes)
         self.prs.apply_conf(cfg, changes, self.raft_log.last_index())
+        if self.metrics is not None:
+            self.metrics.on_conf_change(self._group, self.id, self.term)
         return self.post_conf_change()
 
     def load_state(self, hs: HardState) -> None:

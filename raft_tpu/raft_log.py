@@ -24,7 +24,10 @@ NO_LIMIT = (1 << 64) - 1
 
 
 class RaftLog:
-    __slots__ = ("store", "unstable", "committed", "persisted", "applied")
+    __slots__ = (
+        "store", "unstable", "committed", "persisted", "applied",
+        "on_commit_advance",
+    )
 
     def __init__(self, store: Storage):
         """Initialize cursors from storage (reference: raft_log.rs:79-91)."""
@@ -35,6 +38,10 @@ class RaftLog:
         self.persisted = last_index
         self.applied = first_index - 1
         self.unstable = Unstable(last_index + 1)
+        # Observability hook: called as (old_committed, new_committed) after
+        # every commit_to advance — the single choke point all commit-index
+        # growth flows through (raft_tpu.metrics wires this when enabled).
+        self.on_commit_advance = None
 
     def __str__(self) -> str:
         return (
@@ -146,7 +153,10 @@ class RaftLog:
             raise AssertionError(
                 f"to_commit {to_commit} is out of range [last_index {self.last_index()}]"
             )
+        old = self.committed
         self.committed = to_commit
+        if self.on_commit_advance is not None:
+            self.on_commit_advance(old, to_commit)
 
     def applied_to(self, idx: int) -> None:
         """Advance the applied cursor (reference: raft_log.rs:309-324).

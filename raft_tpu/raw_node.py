@@ -297,6 +297,8 @@ class RawNode:
         rd.is_persisted_msg = raft.state != StateRole.Leader
         rd.light = self._gen_light_ready()
         self.records.append(rd_record)
+        if raft.metrics is not None:
+            raft.metrics.on_ready(rd.must_sync)
         return rd
 
     def has_ready(self) -> bool:
@@ -321,6 +323,8 @@ class RawNode:
 
     def _commit_ready(self, rd: Ready) -> None:
         """reference: raw_node.rs:554-570"""
+        if self.raft.metrics is not None:
+            self.raft.metrics.on_advance()
         if rd.ss is not None:
             self.prev_ss = rd.ss
         if rd.hs is not None:

@@ -3,8 +3,9 @@
 
 `Storage` is the interface the application implements over its durable store;
 `MemStorage` is the thread-safe in-memory implementation used by every test.
-The batched MultiRaft path adds `raft_tpu.multiraft.storage.ArrayStorage`, an
-arena of per-group MemStorage-equivalent state with device-mirrored cursors.
+The batched MultiRaft path keeps its per-group log cursors as dense device
+arrays instead (`raft_tpu.multiraft.sim.SimState`); the host-side `MultiRaft`
+driver pairs each group's `RawNode` with an ordinary per-group Storage.
 """
 
 from __future__ import annotations

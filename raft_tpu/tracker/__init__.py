@@ -4,9 +4,9 @@
 `ProgressTracker` owns the `[peer -> Progress]` map, the active joint
 configuration (voters incoming/outgoing + learners + learners_next), and the
 election vote tally.  The batched MultiRaft path materializes exactly this
-state as dense `[G, P]` planes (see raft_tpu.multiraft.state.MultiRaftState);
-this scalar version is the oracle and the host-side fallback for groups with
-irregular configurations.
+state as dense per-peer planes (see raft_tpu.multiraft.sim.SimState's
+`matched`/`voter_mask`/`learner_mask` arrays); this scalar version is the
+oracle and the host-side fallback for groups with irregular configurations.
 """
 
 from __future__ import annotations

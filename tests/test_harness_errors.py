@@ -8,7 +8,7 @@ import pytest
 
 from raft_tpu.eraftpb import Message, MessageType
 from raft_tpu.errors import StepPeerNotFound
-from raft_tpu.harness import Interface, Network
+from raft_tpu.harness import Network
 from raft_tpu.multiraft.driver import MultiRaft
 from raft_tpu.config import Config
 from raft_tpu.eraftpb import ConfState

@@ -1,0 +1,238 @@
+"""Unit tests for the observability layer (raft_tpu/metrics.py): registry
+semantics, Prometheus text exposition, JSONL event tracing, the Metrics
+facade, and the scalar-core + MultiRaft-driver wiring."""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu import Config, MemStorage, MessageType, StateRole
+from raft_tpu.metrics import (
+    Counter,
+    EventTracer,
+    Gauge,
+    Histogram,
+    Metrics,
+    Registry,
+)
+from raft_tpu.multiraft.driver import MultiRaft
+from raft_tpu.multiraft.simref import ScalarCluster
+from raft_tpu.raft_log import NO_LIMIT
+
+
+# --- primitive semantics ---
+
+
+def test_counter_monotonic():
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    g = Gauge()
+    g.set(10)
+    g.inc(2)
+    g.dec(5)
+    assert g.value == 7
+
+
+def test_histogram_buckets_and_overflow():
+    h = Histogram(bounds=(1.0, 2.0))
+    for v in (0.5, 1.0, 1.5, 99.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(102.0)
+    # Cumulative: le=1.0 -> 2 (0.5, 1.0 inclusive), le=2.0 -> 3, +Inf -> 4.
+    assert h.cumulative() == [(1.0, 2), (2.0, 3), (float("inf"), 4)]
+
+
+# --- registry / family semantics ---
+
+
+def test_registry_idempotent_and_conflicting_registration():
+    r = Registry()
+    a = r.counter("x_total", "help one")
+    b = r.counter("x_total", "different help, same schema")
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("x_total")  # same name, different kind
+    with pytest.raises(ValueError):
+        r.counter("x_total", labelnames=("type",))  # different schema
+
+
+def test_labels_positional_and_keyword_pin_same_child():
+    r = Registry()
+    fam = r.counter("msgs_total", labelnames=("type",))
+    fam.labels("MsgHup").inc()
+    fam.labels(type="MsgHup").inc()
+    fam.labels("MsgBeat").inc(3)
+    assert fam.labels("MsgHup").value == 2
+    assert fam.total() == 5
+    with pytest.raises(ValueError):
+        fam.labels("a", "b")  # wrong arity
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")  # wrong label name
+
+
+def test_snapshot_flat_dict():
+    r = Registry()
+    r.counter("a_total").inc(2)
+    r.gauge("g").set(7)
+    r.histogram("h", buckets=(1.0,)).observe(0.5)
+    snap = r.snapshot()
+    assert snap["a_total"] == 2
+    assert snap["g"] == 7
+    assert snap["h_sum"] == 0.5
+    assert snap["h_count"] == 1
+
+
+# --- Prometheus text exposition ---
+
+
+def test_expose_counter_and_gauge_format():
+    r = Registry()
+    r.counter("raft_x_total", "X events", labelnames=("type",)).labels(
+        type="Election"
+    ).inc(3)
+    r.gauge("raft_g", "a gauge").set(2)
+    text = r.expose()
+    assert "# HELP raft_x_total X events\n" in text
+    assert "# TYPE raft_x_total counter\n" in text
+    assert 'raft_x_total{type="Election"} 3\n' in text
+    assert "# TYPE raft_g gauge\n" in text
+    assert "raft_g 2\n" in text
+
+
+def test_expose_histogram_cumulative_buckets():
+    r = Registry()
+    h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.expose()
+    assert 'lat_seconds_bucket{le="0.1"} 1\n' in text
+    assert 'lat_seconds_bucket{le="1"} 2\n' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3\n' in text
+    assert "lat_seconds_sum 5.55\n" in text
+    assert "lat_seconds_count 3\n" in text
+
+
+def test_expose_escapes_label_values():
+    r = Registry()
+    r.counter("esc_total", labelnames=("v",)).labels(v='a"b\\c\nd').inc()
+    assert 'esc_total{v="a\\"b\\\\c\\nd"} 1\n' in r.expose()
+
+
+# --- event tracer ---
+
+
+def test_tracer_list_sink_and_seq():
+    events = []
+    t = EventTracer(events)
+    t.emit("campaign", group=3, term=2)
+    t.emit("commit_advance", group=3, old=0, new=5)
+    assert [e["event"] for e in events] == ["campaign", "commit_advance"]
+    assert [e["seq"] for e in events] == [0, 1]
+    assert events[1]["new"] == 5
+
+
+def test_tracer_file_sink_jsonl(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    t = EventTracer(path)
+    t.emit("state_transition", group=0, id=1, to="Leader")
+    t.emit("vote_grant", group=0, id=2, candidate=1)
+    t.close()
+    lines = [json.loads(s) for s in open(path).read().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["event"] == "state_transition"
+    assert lines[0]["to"] == "Leader"
+    assert lines[1]["seq"] == 1
+
+
+# --- facade wiring ---
+
+
+def test_metrics_facade_counts_by_message_type():
+    m = Metrics()
+    m.on_send(MessageType.MsgAppend)
+    m.on_send(MessageType.MsgAppend)
+    m.on_recv(MessageType.MsgRequestVote)
+    snap = m.registry.snapshot()
+    assert snap['raft_msgs_sent_total{type="MsgAppend"}'] == 2
+    assert snap['raft_msgs_received_total{type="MsgRequestVote"}'] == 1
+
+
+def test_scalar_cluster_populates_metrics_and_traces():
+    """End-to-end: a 2-group scalar cluster electing leaders and committing
+    entries drives every hot-path hook."""
+    events = []
+    m = Metrics(tracer=EventTracer(events))
+    G, P = 2, 3
+    cluster = ScalarCluster(G, P, metrics=m)
+    appends = np.full(G, 1, np.int64)
+    for _ in range(30):
+        cluster.round(append_n=appends)
+    snap = cluster.snapshot()
+    assert (snap["state"] == StateRole.Leader).sum() == G
+    reg = m.registry.snapshot()
+    assert m.elections_won.value >= G
+    assert m.campaigns.total() >= G
+    assert m.beats.value > 0
+    assert m.commit_entries.value == snap["commit"].sum()
+    assert reg['raft_msgs_sent_total{type="MsgHeartbeat"}'] > 0
+    kinds = {e["event"] for e in events}
+    assert {"state_transition", "campaign", "vote_grant", "commit_advance"} <= kinds
+    # Trace events carry the per-group tag.
+    assert {e["group"] for e in events} == set(range(G))
+    # The Prometheus endpoint renders every family.
+    text = m.registry.expose()
+    assert "# TYPE raft_elections_won_total counter\n" in text
+
+
+def test_multiraft_driver_tick_and_sync_counters():
+    """The batched driver's tick increments the multiraft_* plane and
+    status() carries a metrics snapshot."""
+    m = Metrics()
+    cfg = Config(
+        id=1,
+        election_tick=10,
+        heartbeat_tick=3,
+        max_size_per_msg=NO_LIMIT,
+        max_inflight_msgs=256,
+        metrics=m,
+    )
+    G = 4
+    storages = [
+        MemStorage.new_with_conf_state(([1], [])) for _ in range(G)
+    ]
+    driver = MultiRaft(cfg, storages)
+    # Randomized election timeouts are drawn in [election_tick,
+    # 2*election_tick), so 25 ticks guarantee every group campaigned.
+    n_ticks = 25
+    for _ in range(n_ticks):
+        driver.tick()
+    snap = m.registry.snapshot()
+    assert snap["multiraft_ticks_total"] == n_ticks
+    assert snap["multiraft_tick_sync_seconds_count"] == n_ticks
+    assert snap["multiraft_tick_sync_seconds_sum"] > 0
+    # Single-voter groups campaign within election_tick*2 ticks and
+    # immediately win, so the campaign plane fired at least once per group.
+    assert snap["multiraft_campaign_events_total"] >= G
+    status = driver.status()
+    assert status["metrics"]["multiraft_ticks_total"] == n_ticks
+    assert driver.metrics_snapshot() == m.registry.snapshot()
+
+
+def test_disabled_metrics_cost_nothing():
+    """metrics=None (the default) leaves no registry attached anywhere."""
+    cluster = ScalarCluster(1, 3)
+    for _ in range(15):
+        cluster.round()
+    raft = cluster.networks[0].peers[1].raft
+    assert raft.metrics is None
+    assert raft.raft_log.on_commit_advance is None

@@ -11,7 +11,6 @@ from raft_tpu import (
     MessageType,
     ProgressState,
 )
-from raft_tpu.quorum import U64_MAX
 
 from test_util import (
     empty_entry,

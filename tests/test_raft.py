@@ -3,7 +3,6 @@ leader transfer, check-quorum, pre-vote (ported behaviors from reference:
 harness/tests/integration_cases/test_raft.rs; this file covers the core
 clusters, more feature suites live in sibling test files)."""
 
-import pytest
 
 from raft_tpu import (
     Entry,
@@ -16,7 +15,7 @@ from raft_tpu import (
     Raft,
     StateRole,
 )
-from raft_tpu.harness import Interface, Network
+from raft_tpu.harness import Network
 from raft_tpu.harness.interface import NOP_STEPPER
 
 from test_util import (

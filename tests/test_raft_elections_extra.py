@@ -21,7 +21,7 @@ from test_util import (
     new_test_raft,
     new_test_raft_with_config,
 )
-from test_raft_paper import accept_and_reply, commit_noop_entry
+from test_raft_paper import commit_noop_entry
 
 
 def ents_with_config(terms, pre_vote, id, peers):

@@ -26,7 +26,6 @@ from raft_tpu import (
     conf_state_eq,
 )
 from raft_tpu.eraftpb import decode_conf_change, decode_conf_change_v2
-from raft_tpu.raft_log import NO_LIMIT
 
 from test_util import (
     new_message,

@@ -7,7 +7,6 @@ every peer's (term, state, commit, last_index, last_term)."""
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
 
