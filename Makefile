@@ -3,7 +3,13 @@
 
 PY ?= python
 
-.PHONY: all test test-fast bench bench-suites native examples clean
+# The exact file set the static-analysis gates run over — keep `make lint`,
+# `make typecheck`, CI, and docs/STATIC_ANALYSIS.md in sync by changing it
+# here only.
+CHECK_PATHS = raft_tpu tests bench.py benches docs README.md CHANGES.md
+
+.PHONY: all test test-fast bench bench-suites native examples clean \
+	lint typecheck check
 
 all: native test
 
@@ -14,6 +20,23 @@ cpp/libmultiraft.so: cpp/multiraft_engine.cpp
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Static analysis (docs/STATIC_ANALYSIS.md): graftcheck always runs (it is
+# zero-dependency); ruff runs when installed (CI installs it).
+lint:
+	$(PY) -m tools.graftcheck $(CHECK_PATHS)
+	@if $(PY) -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; \
+	then ruff check .; \
+	else echo "ruff not installed; skipped (CI runs it)"; fi
+
+# mypy is a dev-only dependency; the target fails loudly if it's missing so
+# a silent skip can never masquerade as a green typecheck.
+typecheck:
+	@$(PY) -c "import mypy" 2>/dev/null \
+	|| { echo "mypy not installed (pip install mypy); the CI typecheck job runs it"; exit 1; }
+	$(PY) -m mypy
+
+check: lint typecheck test
 
 test-fast:
 	$(PY) -m pytest tests/ -q --ignore=tests/test_pallas_step.py

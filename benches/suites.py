@@ -154,7 +154,7 @@ def bench_baseline_configs(results, quick):
 
             rng = _np.random.RandomState(0)
             append = jnp.asarray(
-                _np.minimum(rng.zipf(1.8, size=G), 8).astype(_np.int32)
+                _np.minimum(rng.zipf(1.8, size=G), 8), dtype=jnp.int32
             )
         else:
             append = jnp.full((G,), 1 if workload == "uniform" else 0, jnp.int32)
@@ -245,7 +245,9 @@ def bench_config4_joint_churn():
     om_joint = np.zeros((P, G), bool)
     om_joint[2:] = True
     om_none = np.zeros((P, G), bool)
-    st = sim.init_state(cfg, jnp.asarray(vm), jnp.asarray(om_joint))
+    st = sim.init_state(
+        cfg, jnp.asarray(vm, dtype=bool), jnp.asarray(om_joint, dtype=bool)
+    )
     crashed = jnp.zeros((P, G), bool)
     append = jnp.ones((G,), jnp.int32)
     step = functools.partial(sim.step, cfg)
@@ -268,7 +270,7 @@ def bench_config4_joint_churn():
         # planes (donation consumes the previous buffers, like a real
         # reconfig barrier would re-materialize them)
         om = om_none if i % 2 else om_joint
-        st = st._replace(outgoing_mask=jnp.asarray(om))
+        st = st._replace(outgoing_mask=jnp.asarray(om, dtype=bool))
         st = multi(st)
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0

@@ -54,7 +54,10 @@ def load_state(path: str) -> SimState:
         fields = {}
         for name in SimState._fields:
             arr = data[name]
-            fields[name] = jnp.asarray(arr)
+            # np.load arrays are strongly typed, so this dtype is the
+            # checkpointed one verbatim — passed explicitly per the GC001
+            # device-boundary convention, not as a behavioral change.
+            fields[name] = jnp.asarray(arr, dtype=arr.dtype)
     return SimState(**fields)
 
 

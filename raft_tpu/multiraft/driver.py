@@ -109,11 +109,11 @@ class MultiRaft:
         m = self.metrics
         t0 = time.perf_counter() if m is not None else 0.0
         ee, hb, campaign, beat, checkq = self._tick_fn(
-            jnp.asarray(self._state),
-            jnp.asarray(self._ee),
-            jnp.asarray(self._hb),
-            jnp.asarray(self._rt),
-            jnp.asarray(self._promotable),
+            jnp.asarray(self._state, dtype=jnp.int32),
+            jnp.asarray(self._ee, dtype=jnp.int32),
+            jnp.asarray(self._hb, dtype=jnp.int32),
+            jnp.asarray(self._rt, dtype=jnp.int32),
+            jnp.asarray(self._promotable, dtype=bool),
         )
         # np.array copies: jax array views are read-only.
         self._ee = np.array(ee)

@@ -1,18 +1,32 @@
 """Batched Raft kernels as pure jnp functions over [..., P] peer planes.
 
-Each kernel is the vectorized equivalent of a scalar-oracle function:
+Each kernel is the vectorized equivalent of a scalar-oracle function.
+This map is MACHINE-CHECKED: graftcheck GC006 fails if a public function
+here is missing from it or untested under tests/.
 
+  majority_of              <-> quorum size n//2 + 1
+                               (reference: util.rs:118-120)
   committed_index          <-> quorum.MajorityConfig.committed_index
                                (reference: majority.rs:70-124)
+  committed_index_grouped  <-> quorum.MajorityConfig.committed_index with
+                               group-commit enabled
+                               (reference: majority.rs:99-124)
   joint_committed_index    <-> quorum.JointConfig.committed_index
                                (reference: joint.rs:47-51)
   vote_result              <-> quorum.MajorityConfig.vote_result
                                (reference: majority.rs:130-154)
+  joint_vote_result        <-> quorum.JointConfig.vote_result
+                               (reference: joint.rs:56-67)
   timeout_draw             <-> util.deterministic_timeout (both sides use the
                                same 32-bit mixer; reference replaces
                                raft.rs:2744-2756)
   tick_kernel              <-> Raft.tick_election / tick_heartbeat
                                (reference: raft.rs:1024-1079)
+  append_response_update   <-> tracker.Progress.maybe_update
+                               (reference: progress.rs:138-150)
+  zero_counters /          <-> the device mirror of raft_tpu.metrics event
+  count_events                 counters (no reference analog; parity vs the
+                               scalar counts in tests/test_counter_parity.py)
 
 TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
 committed_index is a fixed-width masked sort along the last axis that XLA

@@ -18,12 +18,16 @@ min/max of [G] vectors — no XLA variadic sort), and the whole election
 phase is gated behind a batch-level `lax.cond` so steady-state rounds pay
 only tick + replication + commit.
 
-Protocol scope of v1 (what BASELINE configs 2/3/5 need):
+Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
   * elections with randomized timeouts (counter PRNG keyed (node, term)),
     log-up-to-date vote checks, split votes, term inflation from isolated
     peers, stale-candidate disruption on recovery;
   * steady-state replication with per-round append workloads and quorum
     commit (term-gated, Raft §5.4.2 via the term_start_index trick);
+  * joint-consensus configs (outgoing_mask: double-majority elections and
+    commits) and non-voting learners (learner_mask), with conf changes as
+    host-side mask-swap barriers;
+  * the linearizable ReadIndex barrier, Safe mode (`read_index` below);
   * fault injection by per-round crash (isolation) masks — crashed peers
     keep ticking and campaigning but exchange no messages.
   Not modeled on device yet (host path handles them): pre-vote,
@@ -548,7 +552,10 @@ def step(
     matched = jnp.where(solo_win[:, None, :], 0, matched)
     matched = jnp.where(
         solo_win[:, None, :]
-        & (jnp.arange(P)[None, :, None] == jnp.arange(P)[:, None, None]),
+        & (
+            jnp.arange(P, dtype=jnp.int32)[None, :, None]
+            == jnp.arange(P, dtype=jnp.int32)[:, None, None]
+        ),
         new_last_index[:, None, :],
         matched,
     )
@@ -778,6 +785,9 @@ class ClusterSim:
     _DRAIN_MAX = 128  # never let a window exceed this many rounds
 
     def _drain_counters(self) -> None:
+        # graftcheck: allow-no-host-sync-in-jit — deliberate host-side drain:
+        # runs OUTSIDE the jitted step, at the adaptive cadence documented
+        # above, precisely so the step itself never syncs.
         vals = jax.device_get(self._counters)
         peak = 0
         for i in range(kernels.N_COUNTERS):

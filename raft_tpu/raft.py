@@ -204,6 +204,10 @@ class Raft:
 
     def _on_commit_advance(self, old: int, new: int) -> None:
         """RaftLog.commit_to observability callback (metrics enabled only)."""
+        # graftcheck: allow-metrics-guarded — the hook is registered in
+        # __init__ only when metrics is not None, so the callback cannot
+        # fire on the disabled path; re-checking here would add the very
+        # branch the invariant exists to avoid.
         self.metrics.on_commit_advance(self._group, self.id, self.term, old, new)
 
     # --- accessors (reference: raft.rs:402-598) ---

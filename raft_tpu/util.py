@@ -2,9 +2,12 @@
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import TYPE_CHECKING, List, Sequence
 
 from .eraftpb import Entry
+
+if TYPE_CHECKING:
+    import logging
 
 # A constant representing "no byte limit" (reference: util.rs:19).
 NO_LIMIT = (1 << 64) - 1
@@ -92,7 +95,7 @@ def deterministic_timeout(node_key: int, term: int, lo: int, hi: int) -> int:
     return lo + mix32((node_key * 0x9E3779B1 + term) & _U32) % (hi - lo)
 
 
-def default_logger(name: str = "raft_tpu"):
+def default_logger(name: str = "raft_tpu") -> "logging.Logger":
     """Structured logger for the library (the reference's `default_logger`,
     lib.rs:576-600, adapted to stdlib logging: one stream handler, env-
     filtered via RAFT_TPU_LOG, attached once)."""

@@ -1,0 +1,452 @@
+"""Unit tests for tools/graftcheck: every GC rule has known-bad and
+known-good fixtures, plus the allow-marker escape hatch and its
+justification/typo enforcement (GC000).
+
+Fixtures are written under tmp_path with repo-shaped relative paths because
+rule scoping matches on path suffixes (docs/STATIC_ANALYSIS.md)."""
+
+import textwrap
+
+from tools.graftcheck import Context, all_rules, run_paths
+
+
+# Deliberately-bad fixture content is assembled at runtime: graftcheck scans
+# THIS file too (it is under tests/), and must not trip on literals that
+# only exist to be written into tmp fixtures.
+MARK = "# graftcheck: " + "allow-"
+
+
+def cite(name, rng):
+    return name + ":" + rng
+
+
+def run_on(tmp_path, relpath, source, tests_root=None):
+    f = tmp_path / relpath
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    ctx = Context(
+        repo_root=tmp_path, tests_root=tests_root, reference_root=None
+    )
+    return run_paths([str(f)], all_rules(), ctx)
+
+
+def ids(violations):
+    return [v.rule_id for v in violations]
+
+
+# --- GC001 no-implicit-dtype ---
+
+
+def test_gc001_flags_missing_dtype(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        """\
+        import jax.numpy as jnp
+        x = jnp.zeros((4, 4))
+        y = jnp.arange(8)
+        """,
+    )
+    assert ids(vs) == ["GC001", "GC001"]
+
+
+def test_gc001_accepts_explicit_dtype(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        """\
+        import jax.numpy as jnp
+        a = jnp.zeros((4,), jnp.int32)
+        b = jnp.ones((4,), dtype=bool)
+        c = jnp.full((4,), 7, jnp.int32)
+        d = jnp.arange(8, dtype=jnp.uint32)
+        e = jnp.asarray([1, 2], dtype=jnp.int32)
+        """,
+    )
+    assert vs == []
+
+
+def test_gc001_out_of_scope_module_is_ignored(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/scalar_only.py",
+        """\
+        import jax.numpy as jnp
+        x = jnp.zeros((4,))
+        """,
+    )
+    assert vs == []
+
+
+# --- GC002 no-host-sync-in-jit ---
+
+
+def test_gc002_flags_host_sync_primitives(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        """\
+        import jax
+        import numpy as np
+
+        def step(st):
+            vals = jax.device_get(st)
+            n = st.sum().item()
+            arr = np.asarray(st)
+            return int(st[0])
+        """,
+    )
+    assert ids(vs) == ["GC002"] * 4
+
+
+def test_gc002_class_bodies_may_coerce_but_not_sync(tmp_path):
+    # int() on downloaded values in a host wrapper class is fine; a raw
+    # device_get still is not (it needs the allow marker).
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        """\
+        import jax
+
+        class HostWrapper:
+            def drain(self, vals):
+                return int(vals[0])
+
+            def bad(self, x):
+                return jax.device_get(x)
+        """,
+    )
+    assert ids(vs) == ["GC002"]
+    assert "device_get" in vs[0].message
+
+
+# --- GC003 no-python-branch-on-traced ---
+
+
+def test_gc003_flags_branch_on_traced(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        '''\
+        """doc"""
+
+        def f(x):
+            if x > 0:
+                return x
+            assert x.sum() == 0
+            while x:
+                pass
+        ''',
+    )
+    assert ids(vs) == ["GC003"] * 3
+
+
+def test_gc003_static_tests_pass(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        '''\
+        """doc"""
+        BLOCK = 8
+
+        def f(cfg, x, rounds: int, group_ids=None):
+            if group_ids is None:
+                pass
+            if cfg.heartbeat_tick == 1:
+                pass
+            n = x.shape[0]
+            if n > BLOCK or rounds > 2:
+                pass
+            for p in range(n):
+                if p % 2 == 0:
+                    pass
+            assert rounds >= 1
+        ''',
+    )
+    assert vs == []
+
+
+def test_gc003_rebinding_drops_staticness(tmp_path):
+    # Tuple-unpack, AugAssign, and non-range for loops rebind names to
+    # traced values; branches on them must flag.
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        '''\
+        """doc"""
+
+        def f(x):
+            n = 1
+            n, m = x.nonzero()
+            if n:
+                pass
+            k = 0
+            k += x.sum()
+            while k:
+                pass
+            for v in x:
+                if v > 0:
+                    pass
+        ''',
+    )
+    assert ids(vs) == ["GC003"] * 3
+
+
+def test_gc003_item_with_args_still_flags_gc002(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/kernels.py",
+        '"""majority_of <-> util"""\n\ndef majority_of(x):\n    return x.item(0)\n',
+    )
+    assert "GC002" in ids(vs)
+
+
+# --- GC004 metrics-guarded ---
+
+
+def test_gc004_flags_unguarded_metrics_call(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/raft.py",
+        """\
+        class Raft:
+            def send(self, m):
+                self.metrics.on_send(m)
+        """,
+    )
+    assert ids(vs) == ["GC004"]
+
+
+def test_gc004_guard_idioms_pass(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/raft.py",
+        """\
+        class Raft:
+            def direct(self, m):
+                if self.metrics is not None:
+                    self.metrics.on_send(m)
+
+            def nested(self, m):
+                if m.kind == 1:
+                    if self.metrics is not None:
+                        self.metrics.on_beat()
+
+            def alias(self):
+                mm = self.metrics
+                if mm is not None:
+                    mm.on_tick(n=1)
+
+            def early_return(self):
+                if self.metrics is None:
+                    return {}
+                return self.metrics.registry.snapshot()
+        """,
+    )
+    assert vs == []
+
+
+def test_gc004_aliased_unguarded_is_flagged(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/driver.py",
+        """\
+        class MultiRaft:
+            def tick(self):
+                m = self.metrics
+                m.on_driver_tick(n_active=1)
+        """,
+    )
+    assert ids(vs) == ["GC004"]
+
+
+# --- GC005 citation-check ---
+
+
+def test_gc005_flags_malformed_citation(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/anywhere.py",
+        f"# see {cite('majority.rs', '124-70')} for the scan\n"
+        f"# and {cite('raft.rs', '0-5')} for ticks\n",
+    )
+    assert ids(vs) == ["GC005", "GC005"]
+
+
+def test_gc005_well_formed_citation_passes(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/anywhere.py",
+        """\
+        # see majority.rs:70-124 and joint.rs:47
+        """,
+    )
+    assert vs == []
+
+
+def test_gc005_repo_local_citation_resolves(tmp_path):
+    (tmp_path / "mod.py").write_text("a = 1\nb = 2\nc = 3\n")
+    ok = run_on(tmp_path, "raft_tpu/ok.py", "# cites mod.py:1-3\n")
+    assert ok == []
+    stale = run_on(tmp_path, "raft_tpu/stale.py", "# cites mod.py:2-99\n")
+    assert ids(stale) == ["GC005"]
+    assert "stale" in stale[0].message
+
+
+def test_gc005_checks_markdown_too(tmp_path):
+    vs = run_on(
+        tmp_path, "docs/NOTES.md", f"See {cite('raft.rs', '90-10')}.\n"
+    )
+    assert ids(vs) == ["GC005"]
+
+
+# --- GC006 kernel-parity-map ---
+
+_KERNELS_FIXTURE = '''\
+"""Map:
+
+  mapped_kernel <-> oracle.fn (reference: x.rs:1-2)
+"""
+
+def mapped_kernel(x):
+    return x
+
+def unmapped_kernel(x):
+    return x
+
+def _private(x):
+    return x
+'''
+
+
+def test_gc006_docstring_map_and_test_coverage(tmp_path):
+    tests_root = tmp_path / "tests"
+    tests_root.mkdir()
+    (tests_root / "test_k.py").write_text(
+        "def test_mapped():\n    assert mapped_kernel is not None\n"
+    )
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/kernels.py",
+        _KERNELS_FIXTURE,
+        tests_root=tests_root,
+    )
+    # unmapped_kernel: missing from docstring AND untested; _private exempt.
+    assert ids(vs) == ["GC006", "GC006"]
+    assert all("unmapped_kernel" in v.message for v in vs)
+
+
+def test_gc006_fully_mapped_and_tested_passes(tmp_path):
+    tests_root = tmp_path / "tests"
+    tests_root.mkdir()
+    (tests_root / "test_k.py").write_text(
+        "def test_it():\n    assert kernels.mapped_kernel(1) == 1\n"
+    )
+    fixture = '"""Map: mapped_kernel <-> oracle"""\n\ndef mapped_kernel(x):\n    return x\n'
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/kernels.py",
+        fixture,
+        tests_root=tests_root,
+    )
+    assert vs == []
+
+
+def test_gc006_comment_mention_does_not_count_as_tested(tmp_path):
+    # A kernel named only in a comment/docstring is NOT exercised; the
+    # coverage scan looks at code identifiers, not text.
+    tests_root = tmp_path / "tests"
+    tests_root.mkdir()
+    (tests_root / "test_k.py").write_text(
+        '"""talks about mapped_kernel"""\n# uses mapped_kernel\n'
+    )
+    fixture = '"""Map: mapped_kernel <-> oracle"""\n\ndef mapped_kernel(x):\n    return x\n'
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/kernels.py",
+        fixture,
+        tests_root=tests_root,
+    )
+    assert ids(vs) == ["GC006"]
+    assert "not exercised" in vs[0].message
+
+
+# --- allow markers + GC000 meta enforcement ---
+
+
+def test_allow_marker_same_line_suppresses(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        """\
+        import jax.numpy as jnp
+        x = jnp.zeros((4,))  # graftcheck: allow-no-implicit-dtype — fixture wants weak typing
+        """,
+    )
+    assert vs == []
+
+
+def test_allow_marker_standalone_covers_next_code_line(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        """\
+        import jax
+
+        def drain(c):
+            # graftcheck: allow-no-host-sync-in-jit — deliberate host-side
+            # drain, runs outside the jitted step
+            return jax.device_get(c)
+        """,
+    )
+    assert vs == []
+
+
+def test_allow_marker_by_rule_id(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        """\
+        import jax.numpy as jnp
+        x = jnp.zeros((4,))  # graftcheck: allow-GC001 — fixture
+        """,
+    )
+    assert vs == []
+
+
+def test_allow_marker_without_justification_is_gc000(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        "import jax.numpy as jnp\n"
+        f"x = jnp.zeros((4,))  {MARK}no-implicit-dtype\n",
+    )
+    # The unjustified marker suppresses nothing and is itself flagged.
+    assert sorted(ids(vs)) == ["GC000", "GC001"]
+
+
+def test_allow_marker_unknown_rule_is_gc000(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/scalar.py",
+        f"{MARK}no-such-rule — because\n",
+    )
+    assert ids(vs) == ["GC000"]
+
+
+def test_allow_marker_wrong_rule_does_not_suppress(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/mod.py",
+        """\
+        import jax.numpy as jnp
+        x = jnp.zeros((4,))  # graftcheck: allow-metrics-guarded — wrong rule
+        """,
+    )
+    assert ids(vs) == ["GC001"]
+
+
+def test_syntax_error_reports_parse_error_not_crash(tmp_path):
+    vs = run_on(tmp_path, "raft_tpu/broken.py", "def f(:\n")
+    assert ids(vs) == ["GC000"]
+    assert vs[0].slug == "parse-error"

@@ -151,6 +151,95 @@ def test_timeout_draw_parity():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
 
 
+def test_majority_of_matches_scalar_quorum():
+    counts = jnp.arange(1, 16, dtype=jnp.int32)
+    got = kernels.majority_of(counts)
+    want = [n // 2 + 1 for n in range(1, 16)]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, np.int32))
+
+
+def test_joint_vote_result_parity():
+    """reference: joint.rs:56-67 — win both halves / lose either / else
+    pending, checked against JointConfig.vote_result on random tallies."""
+    rng = random.Random(11)
+    inc, out, gr, rj, want = [], [], [], [], []
+    for _ in range(300):
+        imask, _ = make_case(rng)
+        omask = np.zeros(P, dtype=bool)
+        omask[rng.sample(range(P), rng.randint(0, P))] = True
+        granted = np.zeros(P, dtype=bool)
+        rejected = np.zeros(P, dtype=bool)
+        votes = {}
+        for i in range(P):
+            r = rng.random()
+            if r < 0.4:
+                granted[i] = True
+                votes[i + 1] = True
+            elif r < 0.7:
+                rejected[i] = True
+                votes[i + 1] = False
+        inc.append(imask)
+        out.append(omask)
+        gr.append(granted)
+        rj.append(rejected)
+        joint = JointConfig.from_majorities(
+            MajorityConfig([i + 1 for i in range(P) if imask[i]]),
+            MajorityConfig([i + 1 for i in range(P) if omask[i]]),
+        )
+        want.append(int(joint.vote_result(lambda id: votes.get(id))))
+    got = kernels.joint_vote_result(
+        jnp.asarray(np.stack(gr)),
+        jnp.asarray(np.stack(rj)),
+        jnp.asarray(np.stack(inc)),
+        jnp.asarray(np.stack(out)),
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want, dtype=np.int32))
+
+
+def test_append_response_update_matches_progress_maybe_update():
+    """Batched Progress.maybe_update oracle check (reference:
+    progress.rs:138-150): matched/next advance monotonically, only under
+    the response mask."""
+    from raft_tpu.tracker import Progress
+
+    rng = random.Random(12)
+    matched = np.array([rng.randint(0, 50) for _ in range(P)], np.int32)
+    next_idx = matched + 1
+    resp_index = np.array([rng.randint(0, 80) for _ in range(P)], np.int32)
+    resp_mask = np.array([rng.random() < 0.7 for _ in range(P)], bool)
+    got_m, got_n = kernels.append_response_update(
+        jnp.asarray(matched),
+        jnp.asarray(next_idx),
+        jnp.asarray(resp_index),
+        jnp.asarray(resp_mask),
+    )
+    for i in range(P):
+        pr = Progress(int(next_idx[i]), 10)
+        pr.matched = int(matched[i])
+        if resp_mask[i]:
+            pr.maybe_update(int(resp_index[i]))
+        assert int(got_m[i]) == pr.matched
+        assert int(got_n[i]) == pr.next_idx
+
+
+def test_zero_counters_and_count_events_fold():
+    """The device counter plane: zero_counters starts all-zero int32;
+    count_events folds per-round event masks additively."""
+    ctrs = kernels.zero_counters()
+    assert ctrs.shape == (kernels.N_COUNTERS,)
+    assert ctrs.dtype == jnp.int32
+    assert int(ctrs.sum()) == 0
+    campaign = jnp.asarray([[True, False], [True, True]])
+    beat = jnp.asarray([[False, False], [True, False]])
+    won = jnp.asarray([[True, False], [False, False]])
+    delta = jnp.asarray([[2, 0], [1, 3]], jnp.int32)
+    out = kernels.count_events(ctrs, campaign, beat, won, delta)
+    out = kernels.count_events(out, campaign, beat, won, delta)  # additive
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray([6, 2, 2, 12], np.int32)
+    )
+
+
 def test_tick_kernel_matches_scalar_counters():
     """Tick a batch with mixed roles and verify the counter/mask semantics
     against hand-computed expectations (reference: raft.rs:1024-1079)."""

@@ -7,6 +7,7 @@ every peer's (term, state, commit, last_index, last_term)."""
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from raft_tpu.multiraft import ClusterSim, ScalarCluster, SimConfig
 
@@ -114,6 +115,26 @@ def test_parity_random_schedules():
             return crashed.copy(), append
 
         run_parity(G, P, 80, schedule, seed_note=f"seed {seed}")
+
+
+@pytest.mark.slow  # ~22s of lockstep scalar sim: over the tier-1 budget
+def test_parity_at_scale_g64():
+    """Lockstep parity at G=64 — one order of magnitude past the other
+    cases' G<=8, so cross-group independence bugs (plane indexing, PRNG
+    stream collisions between groups, lane-crossing reductions) that a
+    small batch can mask have 64 chances per round to surface.  Schedule:
+    initial election storm, steady appends, then a staggered crash window
+    over peer 0 of half the groups."""
+    G, P = 64, 3
+
+    def schedule(r):
+        crashed = np.zeros((G, P), bool)
+        if 25 <= r < 45:
+            crashed[::2, 0] = True  # even groups lose peer 0
+        append = np.full(G, (r % 3 == 1) * 2, np.int64)
+        return crashed, append
+
+    run_parity(G, P, 60, schedule)
 
 
 def test_parity_majority_crash_stalls_commit():

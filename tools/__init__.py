@@ -1,0 +1,1 @@
+"""Repo-local developer tooling (not part of the raft_tpu package)."""

@@ -1,0 +1,98 @@
+"""CLI entry point: python -m tools.graftcheck <paths...>"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import Context, run_paths
+from .rules import all_rules
+
+
+def _auto_tests_root(paths: List[str], repo_root: Path) -> Optional[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir() and p.name == "tests":
+            return p
+    fallback = repo_root / "tests"
+    return fallback if fallback.is_dir() else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="repo-specific static analysis (docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*", help=".py/.md files or directories")
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        help="run only these rules (GC id or slug; repeatable)",
+    )
+    ap.add_argument(
+        "--tests-root",
+        default=None,
+        help="tests directory for GC006 (default: a scanned dir named "
+        "'tests', else ./tests)",
+    )
+    ap.add_argument(
+        "--reference-root",
+        default=os.environ.get("GRAFTCHECK_REF_ROOT"),
+        help="reference checkout for GC005 resolution (default: "
+        "$GRAFTCHECK_REF_ROOT, else ./reference if present)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.slug:<28} {r.doc}")
+        return 0
+    if not args.paths:
+        ap.error("the following arguments are required: paths")
+    if args.rule:
+        wanted = {w.lower() for w in args.rule}
+        rules = [
+            r
+            for r in rules
+            if r.id.lower() in wanted or r.slug.lower() in wanted
+        ]
+        if not rules:
+            print(f"no rules match {sorted(wanted)}", file=sys.stderr)
+            return 2
+
+    repo_root = Path.cwd()
+    ref_root = (
+        Path(args.reference_root)
+        if args.reference_root
+        else (repo_root / "reference" if (repo_root / "reference").is_dir() else None)
+    )
+    ctx = Context(
+        repo_root=repo_root,
+        tests_root=(
+            Path(args.tests_root)
+            if args.tests_root
+            else _auto_tests_root(args.paths, repo_root)
+        ),
+        reference_root=ref_root,
+    )
+    violations = run_paths(args.paths, rules, ctx, known_rules=all_rules())
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(
+            f"graftcheck: {len(violations)} violation(s)", file=sys.stderr
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

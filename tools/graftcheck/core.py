@@ -1,0 +1,295 @@
+"""graftcheck core: file model, allow-marker handling, rule runner.
+
+Zero-dependency by design (stdlib ast/re/pathlib only): this runs in CI
+before anything is pip-installed and must never be the reason a dependency
+lands in the image.
+
+Suppression protocol (docs/STATIC_ANALYSIS.md):
+
+    x = jnp.zeros(shape)  # graftcheck: allow-no-implicit-dtype — <why>
+
+A marker suppresses matching violations reported on its own line, or — when
+the marker line is a standalone comment — on the next source line.  The rule
+may be named by slug (``allow-no-implicit-dtype``) or id (``allow-GC001``).
+A marker without a justification (any text after the rule name) or naming an
+unknown rule is itself a violation (GC000): silent or typo'd suppressions
+are exactly the convention rot this tool exists to stop.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, Iterator, List, NamedTuple, Optional, Sequence
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    rule_id: str  # "GC001"
+    slug: str  # "no-implicit-dtype"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule_id} [{self.slug}] {self.message}"
+
+
+class Context(NamedTuple):
+    """Cross-file state shared by rules."""
+
+    repo_root: Path
+    tests_root: Optional[Path]  # for GC006 exercised-by-test checks
+    reference_root: Optional[Path]  # for GC005 citation resolution
+
+
+class SourceFile:
+    """One scanned file: text, lines, and (for .py) a parsed AST."""
+
+    def __init__(self, path: Path, display_path: str):
+        self.path = path
+        self.display_path = display_path
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        if path.suffix == ".py":
+            # A syntax error is reported as a violation by the runner, not
+            # raised: graftcheck must print every finding it can.
+            self.tree = ast.parse(self.text, filename=str(path))
+
+    @property
+    def is_python(self) -> bool:
+        return self.path.suffix == ".py"
+
+    @property
+    def ast_tree(self) -> ast.AST:
+        """The parsed tree; only valid for .py files (rules gate on
+        is_python in applies())."""
+        assert self.tree is not None, "ast_tree requested for a non-.py file"
+        return self.tree
+
+    def norm(self) -> str:
+        """Forward-slash path for suffix/substring scope matching."""
+        return str(self.path.as_posix())
+
+
+class Rule:
+    """Base rule: subclasses set id/slug/doc and override applies/check."""
+
+    id = "GC000"
+    slug = "meta"
+    doc = ""
+
+    def applies(self, sf: SourceFile) -> bool:
+        raise NotImplementedError
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
+_MARKER_RE = re.compile(
+    r"#\s*graftcheck:\s*allow-(?P<rule>[A-Za-z0-9_-]+)(?P<rest>.*)$"
+)
+
+
+class AllowMarker(NamedTuple):
+    line: int  # line the marker is written on (1-based)
+    rule: str  # as written: slug or GCnnn
+    justified: bool
+    standalone: bool  # whole line is the comment
+
+
+def find_markers(sf: SourceFile) -> List[AllowMarker]:
+    out = []
+    for i, line in enumerate(sf.lines, start=1):
+        m = _MARKER_RE.search(line)
+        if not m:
+            continue
+        rest = m.group("rest").strip()
+        # justification = any word characters after the rule name, past
+        # optional punctuation (dash/colon/parens)
+        justified = bool(re.search(r"\w", rest))
+        standalone = line.strip().startswith("#")
+        out.append(AllowMarker(i, m.group("rule"), justified, standalone))
+    return out
+
+
+def _marker_covers(marker: AllowMarker, rule: Rule) -> bool:
+    name = marker.rule.lower()
+    return name in (rule.slug.lower(), rule.id.lower())
+
+
+def apply_markers(
+    sf: SourceFile,
+    violations: Sequence[Violation],
+    rules: Sequence[Rule],
+    markers: Sequence[AllowMarker],
+) -> List[Violation]:
+    """Filter suppressed violations; emit GC000 for bad markers."""
+    by_slug = {r.slug.lower(): r for r in rules}
+    by_id = {r.id.lower(): r for r in rules}
+
+    def covered_line(m: AllowMarker) -> int:
+        """The code line a marker applies to: its own line, or — for a
+        standalone comment (justifications may wrap over several comment
+        lines) — the next non-blank, non-comment line."""
+        if not m.standalone:
+            return m.line
+        i = m.line  # 0-based index of the line after the marker
+        while i < len(sf.lines):
+            stripped = sf.lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+            i += 1
+        return m.line
+
+    kept: List[Violation] = []
+    for v in violations:
+        rule = by_id.get(v.rule_id.lower())
+        suppressed = False
+        for m in markers:
+            if rule is None or not _marker_covers(m, rule) or not m.justified:
+                continue
+            if v.line in (m.line, covered_line(m)):
+                suppressed = True
+                break
+        if not suppressed:
+            kept.append(v)
+    for m in markers:
+        known = m.rule.lower() in by_slug or m.rule.lower() in by_id
+        if not known:
+            kept.append(
+                Violation(
+                    sf.display_path,
+                    m.line,
+                    "GC000",
+                    "allow-marker",
+                    f"allow marker names unknown rule {m.rule!r} "
+                    "(suppresses nothing; fix the rule name)",
+                )
+            )
+        elif not m.justified:
+            kept.append(
+                Violation(
+                    sf.display_path,
+                    m.line,
+                    "GC000",
+                    "allow-marker",
+                    f"allow-{m.rule} marker has no justification; append a "
+                    "one-line reason after the rule name",
+                )
+            )
+    return kept
+
+
+def collect_files(paths: Iterable[str]) -> List[Path]:
+    """Expand CLI path arguments into the .py/.md files to scan."""
+    out: List[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+            out.extend(sorted(p.rglob("*.md")))
+        elif p.suffix in (".py", ".md"):
+            out.append(p)
+    # dedupe, keep order
+    seen = set()
+    uniq = []
+    for p in out:
+        key = p.resolve()
+        if key not in seen:
+            seen.add(key)
+            uniq.append(p)
+    return uniq
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Sequence[Rule],
+    ctx: Context,
+    known_rules: Optional[Sequence[Rule]] = None,
+) -> List[Violation]:
+    """Run `rules` over `paths`.  `known_rules` (default: `rules`) is the
+    full registry used to validate allow markers — when running a filtered
+    subset (--rule), markers naming other real rules are still legal."""
+    if known_rules is None:
+        known_rules = rules
+    violations: List[Violation] = []
+    for path in collect_files(paths):
+        display = str(path)
+        try:
+            sf = SourceFile(path, display)
+        except SyntaxError as e:
+            violations.append(
+                Violation(
+                    display,
+                    e.lineno or 1,
+                    "GC000",
+                    "parse-error",
+                    f"file does not parse: {e.msg}",
+                )
+            )
+            continue
+        markers = find_markers(sf)
+        file_violations: List[Violation] = []
+        for rule in rules:
+            if not rule.applies(sf):
+                continue
+            file_violations.extend(rule.check(sf, ctx))
+        violations.extend(
+            apply_markers(sf, file_violations, known_rules, markers)
+        )
+    violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
+    return violations
+
+
+# --- shared AST helpers used by several rules ---
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'self.metrics.registry' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_local(root: ast.AST) -> Iterator[ast.AST]:
+    """Preorder ast.walk in SOURCE ORDER that does not descend into nested
+    function/class defs — pair with iter_functions to visit each statement
+    exactly once; forward-inference passes rely on the ordering."""
+    stack = list(reversed(list(ast.iter_child_nodes(root))))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def iter_functions(
+    tree: ast.AST, include_class_bodies: bool = True
+) -> Iterator[ast.FunctionDef]:
+    """Yield every FunctionDef; optionally skip methods (class bodies) —
+    device modules keep jit-traced code in module-level functions and
+    host-side wrappers in classes, so rules about traced code skip classes."""
+
+    def walk(node: ast.AST, in_class: bool) -> Iterator[ast.FunctionDef]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                if include_class_bodies:
+                    yield from walk(child, True)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if include_class_bodies or not in_class:
+                    if isinstance(child, ast.FunctionDef):
+                        yield child
+                yield from walk(child, in_class)
+            else:
+                yield from walk(child, in_class)
+
+    yield from walk(tree, False)

@@ -1,0 +1,24 @@
+"""Rule registry: one module per GC rule, assembled in id order."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .gc001_dtype import NoImplicitDtype
+from .gc002_hostsync import NoHostSyncInJit
+from .gc003_traced_branch import NoPythonBranchOnTraced
+from .gc004_metrics_guard import MetricsGuarded
+from .gc005_citations import CitationCheck
+from .gc006_parity_map import KernelParityMap
+
+
+def all_rules() -> List[Rule]:
+    return [
+        NoImplicitDtype(),
+        NoHostSyncInJit(),
+        NoPythonBranchOnTraced(),
+        MetricsGuarded(),
+        CitationCheck(),
+        KernelParityMap(),
+    ]

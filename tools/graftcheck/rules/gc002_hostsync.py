@@ -1,0 +1,104 @@
+"""GC002 no-host-sync-in-jit.
+
+The kernel modules (sim.py, kernels.py, pallas_step.py) hold the jitted
+step bodies; sim.py's docstring promises the hot loop makes no host
+round-trips.  Host-sync primitives — `.item()`, `jax.device_get`,
+`block_until_ready`, `np.asarray` on device arrays — either fail under
+tracing or, worse, silently sync per dispatch when reached from host
+wrappers, so none of them belong in these modules at all; the deliberate
+host-side drains carry an allow marker with a justification.
+
+`int()` / `float()` / `bool()` coercions are flagged only inside the
+module-level (traced) functions: on a traced value they raise
+ConcretizationTypeError at best and force a device sync at worst, while
+the class-body host wrappers use them legitimately on downloaded values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from ..core import Context, Rule, SourceFile, Violation, iter_functions
+
+_KERNEL_MODULES = (
+    "raft_tpu/multiraft/sim.py",
+    "raft_tpu/multiraft/kernels.py",
+    "raft_tpu/multiraft/pallas_step.py",
+)
+
+_NUMPY_ALIASES = {"np", "numpy", "onp", "_np"}
+_COERCIONS = {"int", "float", "bool"}
+
+
+def _is_kernel_module(path: str) -> bool:
+    return any(path.endswith(m) for m in _KERNEL_MODULES)
+
+
+class NoHostSyncInJit(Rule):
+    id = "GC002"
+    slug = "no-host-sync-in-jit"
+    doc = "no host-sync primitives in the jitted step modules"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return sf.is_python and _is_kernel_module(sf.norm())
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        yield from self._sync_primitives(sf)
+        yield from self._coercions(sf)
+
+    def _sync_primitives(self, sf: SourceFile) -> Iterator[Violation]:
+        for node in ast.walk(sf.ast_tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            hit: Optional[Tuple[str, str]] = None
+            if fn.attr == "item":
+                # .item() and .item(i) both download-and-sync.
+                hit = (".item()", "downloads and syncs one element")
+            elif fn.attr == "device_get":
+                hit = ("jax.device_get", "blocks on the device")
+            elif fn.attr == "block_until_ready":
+                hit = ("block_until_ready", "blocks on the device")
+            elif (
+                fn.attr == "asarray"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in _NUMPY_ALIASES
+            ):
+                hit = (
+                    "np.asarray",
+                    "materializes a device array on the host",
+                )
+            if hit:
+                yield Violation(
+                    sf.display_path,
+                    node.lineno,
+                    self.id,
+                    self.slug,
+                    f"{hit[0]} in a kernel module ({hit[1]}); keep host "
+                    "syncs out of sim/kernels/pallas_step or mark the "
+                    "deliberate host-side drain with an allow marker",
+                )
+
+    def _coercions(self, sf: SourceFile) -> Iterator[Violation]:
+        for func in iter_functions(sf.ast_tree, include_class_bodies=False):
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Name) and fn.id in _COERCIONS):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    continue
+                yield Violation(
+                    sf.display_path,
+                    node.lineno,
+                    self.id,
+                    self.slug,
+                    f"{fn.id}(...) inside a traced function forces "
+                    "concretization (host sync / ConcretizationTypeError); "
+                    "use jnp casts (.astype) or move the coercion to the "
+                    "host wrapper",
+                )

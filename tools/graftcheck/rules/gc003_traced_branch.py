@@ -1,0 +1,200 @@
+"""GC003 no-python-branch-on-traced.
+
+Python `if` / `while` / `assert` on a traced value inside the jitted step
+bodies raises ConcretizationTypeError — or, reached before jit during
+tracing setup, silently bakes one concrete branch into the compiled graph
+(the worse failure: no error, wrong program for every other input).
+Control flow on device values must go through jnp.where / lax.cond /
+lax.while_loop.
+
+Staticness is inferred conservatively per function: compile-time-static
+values are constants, `x is None` identity tests on optional arguments,
+`cfg.<field>` reads (SimConfig holds only Python ints — shapes and
+timeouts are trace-time constants by its own docstring), int/bool/str
+annotated parameters, `len()` / `.shape` / `.ndim` / `.dtype` results,
+`range()` loop variables, module-level constants, and arithmetic over
+those.  Anything else is assumed traced; genuinely-static cases the
+inference cannot see get the allow marker with a justification.
+
+Scope: module-level functions of the kernel modules.  Class bodies are the
+host-side wrappers (ClusterSim etc.) and are exempt by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import (
+    Context,
+    Rule,
+    SourceFile,
+    Violation,
+    iter_functions,
+    walk_local,
+)
+from .gc002_hostsync import _is_kernel_module
+
+# SimConfig fields + properties; attribute reads of these names are static.
+_STATIC_CONFIG_FIELDS = {
+    "n_groups",
+    "n_peers",
+    "election_tick",
+    "heartbeat_tick",
+    "collect_counters",
+    "min_timeout",
+    "max_timeout",
+}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "range", "min", "max", "abs", "int", "float", "bool"}
+_STATIC_ANNOTATIONS = {"int", "bool", "str", "float", "SimConfig"}
+
+
+def _target_names(targets: "list[ast.expr]") -> Set[str]:
+    """Every Name bound anywhere in assignment targets, including inside
+    tuple/list unpacking and starred elements."""
+    out: Set[str] = set()
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def _module_constants(tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class _StaticNames:
+    """One conservative forward pass over a function body collecting names
+    provably bound to compile-time-static values (no control-flow joins —
+    a name rebound to a non-static value anywhere drops out)."""
+
+    def __init__(self, func: ast.FunctionDef, module_static: Set[str]):
+        self.static: Set[str] = set(module_static)
+        for arg in func.args.args + func.args.kwonlyargs:
+            ann = arg.annotation
+            if (
+                isinstance(ann, ast.Name)
+                and ann.id in _STATIC_ANNOTATIONS
+            ) or arg.arg == "cfg":
+                self.static.add(arg.arg)
+        for stmt in walk_local(func):
+            if isinstance(stmt, ast.Assign):
+                # Tuple-unpack targets are dropped wholesale (mapping value
+                # elements to targets is not worth the precision); plain
+                # Name targets follow the value's staticness.
+                names = _target_names(stmt.targets)
+                if self.is_static(stmt.value) and all(
+                    isinstance(t, ast.Name) for t in stmt.targets
+                ):
+                    self.static.update(names)
+                else:
+                    self.static.difference_update(names)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(stmt.target, ast.Name):
+                    value = stmt.value
+                    keep = value is not None and self.is_static(value)
+                    if isinstance(stmt, ast.AugAssign):
+                        # x += v stays static only if x already was AND v is.
+                        keep = keep and stmt.target.id in self.static
+                    if keep:
+                        self.static.add(stmt.target.id)
+                    else:
+                        self.static.discard(stmt.target.id)
+            elif isinstance(stmt, ast.For):
+                if (
+                    isinstance(stmt.target, ast.Name)
+                    and isinstance(stmt.iter, ast.Call)
+                    and isinstance(stmt.iter.func, ast.Name)
+                    and stmt.iter.func.id == "range"
+                    and all(self.is_static(a) for a in stmt.iter.args)
+                ):
+                    self.static.add(stmt.target.id)
+                else:
+                    # Iterating anything else yields non-static values.
+                    self.static.difference_update(
+                        _target_names([stmt.target])
+                    )
+
+    def is_static(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.static
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return True  # shape metadata is static even on traced arrays
+            if node.attr in _STATIC_CONFIG_FIELDS:
+                return self.is_static(node.value)
+            return False
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return True  # `x is None`: trace-time identity on optionals
+            return self.is_static(node.left) and all(
+                self.is_static(c) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return all(self.is_static(v) for v in node.values)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand)
+        if isinstance(node, ast.BinOp):
+            return self.is_static(node.left) and self.is_static(node.right)
+        if isinstance(node, ast.Call):
+            return (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _STATIC_CALLS
+                and all(self.is_static(a) for a in node.args)
+            )
+        if isinstance(node, ast.Subscript):
+            return self.is_static(node.value) and self.is_static(node.slice)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return all(self.is_static(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (
+                self.is_static(node.test)
+                and self.is_static(node.body)
+                and self.is_static(node.orelse)
+            )
+        return False
+
+
+class NoPythonBranchOnTraced(Rule):
+    id = "GC003"
+    slug = "no-python-branch-on-traced"
+    doc = "no Python if/while/assert on traced values in kernel modules"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return sf.is_python and _is_kernel_module(sf.norm())
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        module_static = _module_constants(sf.ast_tree)
+        for func in iter_functions(sf.ast_tree, include_class_bodies=False):
+            names = _StaticNames(func, module_static)
+            for node in walk_local(func):
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                else:
+                    continue
+                if names.is_static(test):
+                    continue
+                yield Violation(
+                    sf.display_path,
+                    node.lineno,
+                    self.id,
+                    self.slug,
+                    f"Python `{kind}` on a value not provably static at "
+                    "trace time; use jnp.where/lax.cond (or add an allow "
+                    "marker if the value is static in a way the inference "
+                    "cannot see)",
+                )

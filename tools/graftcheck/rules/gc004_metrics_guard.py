@@ -1,0 +1,197 @@
+"""GC004 metrics-guarded.
+
+PR 1's observability contract: with metrics disabled (`Config.metrics is
+None`, the default), every hook site costs exactly one predictable branch
+— `if self.metrics is not None:`.  An unguarded `*.metrics.on_*()` call
+crashes the disabled path outright (AttributeError on None) or, aliased,
+silently re-introduces per-event overhead.  This rule keeps the invariant
+mechanical instead of review-enforced.
+
+A call through a metrics receiver (`self.metrics.x()`, `raft.metrics.x()`,
+an alias assigned from `*.metrics`, or a deeper chain like
+`self.metrics.registry.snapshot()`) counts as guarded when either
+
+  * an enclosing `if <receiver> is not None:` dominates it (or it sits in
+    the else-branch of `is None`), where <receiver> is a dot-prefix of the
+    call's receiver, or
+  * an earlier function-body statement `if <receiver> is None: return/raise`
+    dominates the rest of the function (the early-return idiom).
+
+Callback methods invoked only when metrics are enabled (hook registration
+sites) use the allow marker with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import (
+    Context,
+    Rule,
+    SourceFile,
+    Violation,
+    dotted_name,
+    iter_functions,
+    walk_local,
+)
+
+_METRICS_MODULES = (
+    "raft_tpu/raft.py",
+    "raft_tpu/raw_node.py",
+    "raft_tpu/multiraft/driver.py",
+)
+
+
+def _is_prefix(guard: str, receiver: str) -> bool:
+    """'self.metrics' guards 'self.metrics' and 'self.metrics.registry'."""
+    return receiver == guard or receiver.startswith(guard + ".")
+
+
+def _none_check(test: ast.expr) -> List[Tuple[str, bool]]:
+    """[(dotted receiver, is_not_none)] comparisons found in `test`,
+    including the operands of a top-level `and`."""
+    out: List[Tuple[str, bool]] = []
+    exprs = test.values if isinstance(test, ast.BoolOp) and isinstance(
+        test.op, ast.And
+    ) else [test]
+    for e in exprs:
+        if (
+            isinstance(e, ast.Compare)
+            and len(e.ops) == 1
+            and isinstance(e.comparators[0], ast.Constant)
+            and e.comparators[0].value is None
+        ):
+            name = dotted_name(e.left)
+            if name is not None:
+                out.append((name, isinstance(e.ops[0], ast.IsNot)))
+    return out
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue)
+    )
+
+
+class MetricsGuarded(Rule):
+    id = "GC004"
+    slug = "metrics-guarded"
+    doc = "every metrics call site sits behind the enabled-check"
+
+    def applies(self, sf: SourceFile) -> bool:
+        p = sf.norm()
+        return sf.is_python and any(p.endswith(m) for m in _METRICS_MODULES)
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        for func in iter_functions(sf.ast_tree, include_class_bodies=True):
+            yield from self._check_function(sf, func)
+
+    def _metrics_aliases(self, func: ast.FunctionDef) -> Set[str]:
+        """Names assigned from an expression ending in `.metrics`."""
+        aliases: Set[str] = set()
+        for stmt in walk_local(func):
+            if isinstance(stmt, ast.Assign):
+                src = dotted_name(stmt.value)
+                if src is not None and (
+                    src == "metrics" or src.endswith(".metrics")
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+        return aliases
+
+    def _receiver(self, call: ast.Call, aliases: Set[str]) -> Optional[str]:
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        recv = dotted_name(call.func.value)
+        if recv is None:
+            return None
+        segments = recv.split(".")
+        if "metrics" in segments or segments[0] in aliases:
+            return recv
+        return None
+
+    def _guard_prefixes(self, recv: str, aliases: Set[str]) -> List[str]:
+        """Receiver prefixes whose None-check guards the call: for
+        'self.metrics.registry' -> ['self.metrics.registry', 'self.metrics'];
+        for an alias 'm' -> ['m']."""
+        parts = recv.split(".")
+        out = []
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            out.append(prefix)
+            if parts[i - 1] == "metrics" or prefix in aliases:
+                break
+        return out
+
+    def _check_function(
+        self, sf: SourceFile, func: ast.FunctionDef
+    ) -> Iterator[Violation]:
+        aliases = self._metrics_aliases(func)
+
+        # Early-return guards: top-level `if X is None: return/raise` makes
+        # everything after it in the body guarded for receiver-prefix X.
+        early: List[Tuple[str, int]] = []  # (guarded name, effective line)
+        for stmt in func.body:
+            if isinstance(stmt, ast.If) and _terminates(stmt.body):
+                for name, is_not in _none_check(stmt.test):
+                    if not is_not:
+                        early.append((name, stmt.end_lineno or stmt.lineno))
+
+        # Walk with the active guard set; entering an If's body/orelse adds
+        # its None-checks to the guards for that branch.
+        def visit(node: ast.AST, active: List[str]) -> Iterator[Violation]:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return  # nested defs are visited as their own roots
+            if isinstance(node, ast.If):
+                checks = _none_check(node.test)
+                body_guards = [n for n, is_not in checks if is_not]
+                else_guards = [n for n, is_not in checks if not is_not]
+                yield from visit(node.test, active)
+                for sub in node.body:
+                    yield from visit(sub, active + body_guards)
+                for sub in node.orelse:
+                    yield from visit(sub, active + else_guards)
+                return
+            if isinstance(node, ast.Call):
+                yield from self._visit_stmt(sf, node, active, aliases, early)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, active)
+
+        for stmt in func.body:
+            yield from visit(stmt, [])
+
+    def _visit_stmt(
+        self,
+        sf: SourceFile,
+        node: ast.AST,
+        active: List[str],
+        aliases: Set[str],
+        early: List[Tuple[str, int]],
+    ) -> Iterator[Violation]:
+        if not isinstance(node, ast.Call):
+            return
+        recv = self._receiver(node, aliases)
+        if recv is None:
+            return
+        prefixes = self._guard_prefixes(recv, aliases)
+        for g in active:
+            if any(_is_prefix(g, p) or _is_prefix(p, g) for p in prefixes):
+                return
+        for name, line in early:
+            if node.lineno > line and any(
+                _is_prefix(name, p) or _is_prefix(p, name) for p in prefixes
+            ):
+                return
+        yield Violation(
+            sf.display_path,
+            node.lineno,
+            self.id,
+            self.slug,
+            f"metrics call through `{recv}` is not behind an "
+            "`is not None` enabled-check; guard it (PR 1 single-branch "
+            "invariant) or mark a callback-only site with an allow marker",
+        )

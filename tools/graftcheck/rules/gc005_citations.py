@@ -1,0 +1,93 @@
+"""GC005 citation-check.
+
+The codebase cites the reference tree (`majority.rs:70-124`) and its own
+files (`tests/test_sim_fuzz.py`) throughout docstrings and comments; PR 1
+already had to hand-fix a batch of rotted cites.  This rule makes the
+class mechanical:
+
+  * every `file.ext:NN[-MM]` citation must be well-formed (NN >= 1,
+    MM >= NN);
+  * citations into files that exist in THIS repo resolve against them
+    (the line range must exist), so local cites rot loudly;
+  * when a reference checkout is available (--reference-root, the
+    GRAFTCHECK_REF_ROOT env var, or ./reference/), `.rs` cites resolve
+    against it the same way — CI without the checkout still gets the
+    well-formedness check.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ..core import Context, Rule, SourceFile, Violation
+
+_CITE_RE = re.compile(
+    r"(?P<file>[A-Za-z_][\w./-]*\.(?:rs|py|cpp|cc|h|go)):"
+    r"(?P<lo>\d+)(?:-(?P<hi>\d+))?"
+)
+
+
+@lru_cache(maxsize=512)
+def _line_count(path: str) -> int:
+    return len(Path(path).read_text(encoding="utf-8").splitlines())
+
+
+def _resolve(root: Path, cited: str) -> Optional[Path]:
+    """Find `cited` under root: direct, under src/, or by unique suffix."""
+    for candidate in (root / cited, root / "src" / cited):
+        if candidate.is_file():
+            return candidate
+    name = Path(cited).name
+    hits = [p for p in root.rglob(name) if str(p.as_posix()).endswith(cited)]
+    return hits[0] if len(hits) == 1 else None
+
+
+class CitationCheck(Rule):
+    id = "GC005"
+    slug = "citation-check"
+    doc = "file:line citations are well-formed and resolve when checkable"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return True  # .py and .md alike
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        for i, line in enumerate(sf.lines, start=1):
+            for m in _CITE_RE.finditer(line):
+                cited, lo_s, hi_s = m.group("file"), m.group("lo"), m.group("hi")
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s is not None else lo
+                if lo < 1 or hi < lo:
+                    yield Violation(
+                        sf.display_path,
+                        i,
+                        self.id,
+                        self.slug,
+                        f"malformed citation {m.group(0)!r}: line range "
+                        "must be 1-based and ascending",
+                    )
+                    continue
+                target = self._target(ctx, cited)
+                if target is None:
+                    continue  # nothing to resolve against; format-only check
+                n = _line_count(str(target))
+                if hi > n:
+                    yield Violation(
+                        sf.display_path,
+                        i,
+                        self.id,
+                        self.slug,
+                        f"stale citation {m.group(0)!r}: {target} has only "
+                        f"{n} lines",
+                    )
+
+    def _target(self, ctx: Context, cited: str) -> Optional[Path]:
+        # Repo-local cites (our own .py/.cpp files) resolve against the repo.
+        local = ctx.repo_root / cited
+        if local.is_file():
+            return local
+        if ctx.reference_root is not None and ctx.reference_root.is_dir():
+            return _resolve(ctx.reference_root, cited)
+        return None

@@ -1,0 +1,84 @@
+"""GC006 kernel-parity-map.
+
+kernels.py is the seam between the scalar oracle and the batched device
+path; its module docstring carries the kernel <-> oracle map that parity
+reviewers navigate by.  Every public function there must (a) appear in
+that map and (b) be exercised by at least one test under tests/ — an
+unmapped or untested kernel is exactly how a silent divergence ships.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, List, Set
+
+from ..core import Context, Rule, SourceFile, Violation
+
+
+def _public_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [
+        node
+        for node in ast.iter_child_nodes(tree)
+        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_")
+    ]
+
+
+def _test_identifiers(tests_root: Path) -> Set[str]:
+    """Identifiers actually used in test CODE (Name/Attribute nodes —
+    `kernels.foo(...)` and `from ... import foo` alike).  Deliberately NOT
+    a word-level text scan: a kernel mentioned only in a comment or
+    docstring is not exercised.  Files that fail to parse fall back to the
+    text scan rather than silently contributing nothing."""
+    idents: Set[str] = set()
+    for path in sorted(tests_root.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            idents.update(re.findall(r"\w+", text))
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                idents.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                idents.add(node.attr)
+    return idents
+
+
+class KernelParityMap(Rule):
+    id = "GC006"
+    slug = "kernel-parity-map"
+    doc = "public kernels are in the oracle-map docstring and tested"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return sf.is_python and sf.norm().endswith("raft_tpu/multiraft/kernels.py")
+
+    def check(self, sf: SourceFile, ctx: Context) -> Iterator[Violation]:
+        docstring = ast.get_docstring(sf.ast_tree) or ""
+        doc_words = set(re.findall(r"\w+", docstring))
+        test_idents: Set[str] = set()
+        have_tests = False
+        if ctx.tests_root is not None and ctx.tests_root.is_dir():
+            have_tests = True
+            test_idents = _test_identifiers(ctx.tests_root)
+        for func in _public_functions(sf.ast_tree):
+            if func.name not in doc_words:
+                yield Violation(
+                    sf.display_path,
+                    func.lineno,
+                    self.id,
+                    self.slug,
+                    f"public kernel `{func.name}` is missing from the "
+                    "module docstring's kernel <-> oracle map",
+                )
+            if have_tests and func.name not in test_idents:
+                yield Violation(
+                    sf.display_path,
+                    func.lineno,
+                    self.id,
+                    self.slug,
+                    f"public kernel `{func.name}` is not exercised by any "
+                    "test under tests/",
+                )
