@@ -22,8 +22,22 @@ quieter host.
 vs_baseline = median device ticks/sec ÷ median native-CPU ticks/sec, both at
 the same per-group work (the reference publishes no numbers — BASELINE.md —
 so the anchor is measured in-process on the same host).
+
+Flags (all optional; defaults reproduce the BENCH_r0x methodology):
+
+  --profile DIR   capture a jax.profiler (XLA) trace of the timed region
+                  into DIR (raft_tpu.profiling.start_trace/stop_trace);
+                  view with TensorBoard's profile plugin / Perfetto.
+  --health        thread the device fleet-health planes through the timed
+                  region (pallas_step.fast_multi_round(..., with_health))
+                  — the <5% overhead claim of docs/OBSERVABILITY.md.
+  --health-out F  write the end-of-run health summary JSON to F.
+  --groups N      shrink the batch (CI artifact runs; default 100000).
+  --reps N        repetition count (>=5 for comparable medians).
+  --skip-anchor   skip the native-CPU anchor (vs_baseline becomes null).
 """
 
+import argparse
 import functools
 import json
 import statistics
@@ -60,54 +74,115 @@ def rep_stats(samples) -> dict:
     }
 
 
-def bench_device() -> dict:
+def bench_device(
+    groups: int = G,
+    reps: int = REPS,
+    health: bool = False,
+    profile_dir: str = "",
+    health_out: str = "",
+) -> dict:
     from raft_tpu.multiraft import pallas_step, sim
     from raft_tpu.multiraft.sim import SimConfig
 
-    cfg = SimConfig(n_groups=G, n_peers=P)
+    # CPU runs (the CI artifact job) have no Mosaic lowering: build the
+    # pallas kernels in interpret mode — numbers from such a run are NOT
+    # comparable to TPU medians.
+    interpret = jax.default_backend() == "cpu"
+
+    cfg = SimConfig(n_groups=groups, n_peers=P)
     state = sim.init_state(cfg)
-    crashed = jnp.zeros((P, G), bool)
-    append = jnp.ones((G,), jnp.int32)
+    crashed = jnp.zeros((P, groups), bool)
+    append = jnp.ones((groups,), jnp.int32)
 
     # Every protocol round executes fully; the fused pallas kernel runs K
     # rounds per VMEM residency when the steady invariant provably holds,
     # with a lax.cond fallback to the general XLA step (bit-identical
-    # semantics; see raft_tpu/multiraft/pallas_step.py).
+    # semantics; see raft_tpu/multiraft/pallas_step.py).  With --health the
+    # per-group health planes ride through both branches
+    # (fast_multi_round(..., with_health=True)).
     K = 32
-    kstep = pallas_step.fast_multi_round(cfg, k=K)
+    kstep = pallas_step.fast_multi_round(
+        cfg, k=K, with_health=health, interpret=interpret
+    )
     full = jax.jit(functools.partial(sim.step, cfg))
+    hstate = sim.init_health(cfg) if health else None
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def multi_round(st):
-        def body(s, _):
-            return kstep(s, crashed, append), ()
+    if health:
 
-        st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN // K)
-        return st
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def multi_round_h(st, h):
+            def body(carry, _):
+                s, hh = carry
+                return kstep(s, crashed, append, hh), ()
+
+            carry, _ = jax.lax.scan(
+                body, (st, h), None, length=ROUNDS_PER_SCAN // K
+            )
+            return carry
+
+    else:
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def multi_round(st):
+            def body(s, _):
+                return kstep(s, crashed, append), ()
+
+            st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN // K)
+            return st
+
+    def advance(st, h):
+        if health:
+            return multi_round_h(st, h)
+        return multi_round(st), None
 
     # Warm up: compile + let the election storm settle into steady state.
     for _ in range(30):
         state = full(state, crashed, append)
-    state = multi_round(state)
+    state, hstate = advance(state, hstate)
     jax.block_until_ready(state)
 
     rounds = (ROUNDS_PER_SCAN // K) * K * SCANS
-    ticks = G * rounds
+    ticks = groups * rounds
     samples = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        for _ in range(SCANS):
-            state = multi_round(state)
-        jax.block_until_ready(state)
-        samples.append(ticks / (time.perf_counter() - t0))
+    if profile_dir:
+        from raft_tpu import profiling
+
+        profiling.start_trace(profile_dir)
+    try:
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(SCANS):
+                state, hstate = advance(state, hstate)
+            jax.block_until_ready(state)
+            samples.append(ticks / (time.perf_counter() - t0))
+    finally:
+        if profile_dir:
+            profiling.stop_trace()
 
     # Sanity: the protocol is actually running (leaders + commits advance).
     commit_min = int(jnp.min(jnp.max(state.commit, axis=0)))
     assert commit_min > 0, "bench sanity: no commits on device"
+    if health and health_out:
+        from raft_tpu.multiraft import kernels
+        from raft_tpu.multiraft.health import HealthMonitor
+
+        counts, hist, ids, scores = jax.device_get(
+            kernels.health_summary(
+                hstate.planes,
+                cfg.leaderless_stall_ticks,
+                cfg.commit_stall_ticks,
+                cfg.churn_bumps,
+                min(cfg.health_topk, groups),
+            )
+        )
+        with open(health_out, "w") as f:
+            json.dump(
+                HealthMonitor.summary_dict(counts, hist, ids, scores), f
+            )
     return rep_stats(samples)
 
 
-def bench_scalar_anchor() -> dict:
+def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
     engine = NativeMultiRaft(ANCHOR_GROUPS, P)
@@ -115,7 +190,7 @@ def bench_scalar_anchor() -> dict:
     # Let elections settle before timing (same steady state as the device).
     engine.run(25, None, append)
     samples = []
-    for _ in range(REPS):
+    for _ in range(reps):
         t0 = time.perf_counter()
         engine.run(ANCHOR_ROUNDS, None, append)
         samples.append(
@@ -137,29 +212,51 @@ def warn_spread(name: str, stats: dict) -> None:
 
 
 def main() -> None:
-    device = bench_device()
-    anchor = bench_scalar_anchor()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", default="", metavar="DIR")
+    ap.add_argument("--health", action="store_true")
+    ap.add_argument("--health-out", default="", metavar="FILE")
+    ap.add_argument("--groups", type=int, default=G)
+    ap.add_argument("--reps", type=int, default=REPS)
+    ap.add_argument("--skip-anchor", action="store_true")
+    args = ap.parse_args()
+    if args.health_out and not args.health:
+        ap.error("--health-out requires --health")
+
+    device = bench_device(
+        groups=args.groups,
+        reps=args.reps,
+        health=args.health,
+        profile_dir=args.profile,
+        health_out=args.health_out,
+    )
+    anchor = None if args.skip_anchor else bench_scalar_anchor(args.reps)
     # A flagged spread on EITHER side poisons vs_baseline (it is a ratio of
     # the two medians), so both are checked.
     warn_spread("device", device)
-    warn_spread("native-CPU anchor", anchor)
-    print(
-        json.dumps(
-            {
-                "metric": "raft_ticks_per_sec_100k_groups_5_peers",
-                "value": device["median"],
-                "unit": "ticks/sec",
-                "vs_baseline": round(device["median"] / anchor["median"], 2),
-                **device,
-                # A flagged anchor poisons vs_baseline just as much as a
-                # flagged device, so the top-level flag ORs both sides.
-                "spread_flagged": (
-                    device["spread_flagged"] or anchor["spread_flagged"]
-                ),
-                "anchor": anchor,
-            }
-        )
-    )
+    if anchor is not None:
+        warn_spread("native-CPU anchor", anchor)
+    line = {
+        "metric": "raft_ticks_per_sec_100k_groups_5_peers",
+        "value": device["median"],
+        "unit": "ticks/sec",
+        "vs_baseline": (
+            None
+            if anchor is None
+            else round(device["median"] / anchor["median"], 2)
+        ),
+        **device,
+        # A flagged anchor poisons vs_baseline just as much as a flagged
+        # device, so the top-level flag ORs both sides.
+        "spread_flagged": device["spread_flagged"]
+        or (anchor is not None and anchor["spread_flagged"]),
+        "anchor": anchor,
+    }
+    if args.groups != G:
+        line["groups"] = args.groups
+    if args.health:
+        line["health"] = True
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -75,7 +75,14 @@ from .raw_node import (
 )
 from .read_only import ReadOnly, ReadOnlyOption, ReadState
 from .status import Status
-from .storage import MemStorage, MemStorageCore, RaftState, Storage
+from .storage import (
+    ArrayStorage,
+    ArrayStorageCore,
+    MemStorage,
+    MemStorageCore,
+    RaftState,
+    Storage,
+)
 from .tracker import (
     Configuration,
     Inflights,
@@ -123,6 +130,8 @@ __all__ = [
     "SnapshotStatus",
     "RaftLog",
     "Storage",
+    "ArrayStorage",
+    "ArrayStorageCore",
     "MemStorage",
     "MemStorageCore",
     "RaftState",

@@ -20,6 +20,47 @@ if TYPE_CHECKING:
 INVALID_ID = 0
 INVALID_INDEX = 0
 
+
+@dataclass
+class HealthConfig:
+    """Fleet-health telemetry thresholds (raft-tpu extension; no reference
+    analog — the reference observes one group, this observes 100k).
+
+    Shared by the host HealthMonitor (raft_tpu/multiraft/health.py), the
+    MultiRaft driver's numpy health planes, and — mirrored into the
+    SimConfig fields of the same names — the device-resident planes
+    (raft_tpu/multiraft/sim.py).  All values are in ticks/rounds except
+    `churn_bumps` (term bumps per window) and the two sizes.
+    """
+
+    # Churn window length: term_bumps_in_window covers at most this many
+    # trailing rounds.
+    window: int = 32
+    # A group is "stalled leaderless" at/over this many leaderless ticks.
+    leaderless_stall_ticks: int = 16
+    # A group is "commit stalled" at/over this many flat-commit ticks.
+    commit_stall_ticks: int = 32
+    # A group is "churning" at/over this many term bumps per window.
+    churn_bumps: int = 4
+    # Worst-offender extraction width (top-k).
+    topk: int = 8
+    # Flight-recorder ring capacity (summaries kept for post-mortems).
+    recorder_size: int = 64
+
+    def validate(self) -> None:
+        if self.window <= 0:
+            raise ConfigInvalid("health window must be greater than 0")
+        if self.topk <= 0:
+            raise ConfigInvalid("health topk must be greater than 0")
+        if self.recorder_size <= 0:
+            raise ConfigInvalid("health recorder size must be greater than 0")
+        if min(
+            self.leaderless_stall_ticks,
+            self.commit_stall_ticks,
+            self.churn_bumps,
+        ) <= 0:
+            raise ConfigInvalid("health thresholds must be greater than 0")
+
 # Default ceiling on committed entries delivered per Ready
 # (reference: config.rs:103-125 uses MAX_COMMITTED_SIZE_PER_READY).
 MAX_COMMITTED_SIZE_PER_READY = NO_LIMIT

@@ -479,6 +479,46 @@ class Metrics:
             "Host<->device round-trip latency of the batched tick",
             buckets=DEFAULT_LATENCY_BUCKETS,
         )
+        self.ready_scan_scanned = r.counter(
+            "multiraft_ready_scan_groups_scanned_total",
+            "Groups actually probed by ready_groups() (the dirty set)",
+        )
+        self.ready_scan_skipped = r.counter(
+            "multiraft_ready_scan_groups_skipped_total",
+            "Idle groups ready_groups() skipped without any host work",
+        )
+
+        # Fleet-health plane (multiraft/health.py HealthMonitor summaries).
+        self.health_summaries = r.counter(
+            "health_summaries_total", "Health summaries recorded"
+        )
+        self.health_leaderless = r.gauge(
+            "health_groups_leaderless", "Groups currently without a leader"
+        )
+        self.health_stalled_leaderless = r.gauge(
+            "health_groups_stalled_leaderless",
+            "Groups leaderless at/over the stall threshold",
+        )
+        self.health_commit_stalled = r.gauge(
+            "health_groups_commit_stalled",
+            "Groups with a flat commit index at/over the stall threshold",
+        )
+        self.health_churning = r.gauge(
+            "health_groups_churning",
+            "Groups with term bumps in window at/over the churn threshold",
+        )
+        self.health_worst_score = r.gauge(
+            "health_worst_group_score",
+            "Worst-offender score (max of commit lag and leaderless ticks)",
+        )
+        # The device reduces commit lag into fixed buckets already, so this
+        # is a labeled gauge family (a point-in-time distribution), not a
+        # Histogram (which accumulates observations).
+        self.health_commit_lag = r.gauge(
+            "health_commit_lag_groups",
+            "Groups per commit-lag bucket (lower bound label, ticks)",
+            ("ge",),
+        )
 
     # --- tracing ---
 
@@ -593,3 +633,29 @@ class Metrics:
         self.driver_checkq_fired.inc(n_checkq)
         self.driver_last_active.set(n_active)
         self.driver_sync_seconds.observe(sync_seconds)
+
+    def on_ready_scan(self, scanned: int, skipped: int) -> None:
+        self.ready_scan_scanned.inc(scanned)
+        self.ready_scan_skipped.inc(skipped)
+
+    # --- fleet-health hooks (multiraft/health.py HealthMonitor) ---
+
+    def on_health_summary(self, summary: dict) -> None:
+        """Publish one fixed-size health summary (the dict shape produced
+        by ClusterSim.health() / MultiRaft.health()) as gauges."""
+        from .multiraft.kernels import LAG_BUCKET_BOUNDS
+
+        self.health_summaries.inc()
+        counts = summary.get("counts", {})
+        self.health_leaderless.set(counts.get("leaderless", 0))
+        self.health_stalled_leaderless.set(
+            counts.get("stalled_leaderless", 0)
+        )
+        self.health_commit_stalled.set(counts.get("commit_stalled", 0))
+        self.health_churning.set(counts.get("churning", 0))
+        worst = summary.get("worst") or []
+        if worst:
+            self.health_worst_score.set(worst[0]["score"])
+        bounds = (0,) + LAG_BUCKET_BOUNDS
+        for lo, n in zip(bounds, summary.get("lag_hist", ())):
+            self.health_commit_lag.labels(ge=lo).set(n)

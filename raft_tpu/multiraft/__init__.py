@@ -26,8 +26,15 @@ from .kernels import (
     timeout_draw,
     vote_result,
 )
-from .sim import ClusterSim, SimConfig, SimState, read_index
-from .simref import ScalarCluster
+from .sim import (
+    ClusterSim,
+    HealthState,
+    SimConfig,
+    SimState,
+    init_health,
+    read_index,
+)
+from .simref import HealthOracle, ScalarCluster
 
 __all__ = [
     "committed_index",
@@ -39,7 +46,10 @@ __all__ = [
     "ClusterSim",
     "SimConfig",
     "SimState",
+    "HealthState",
+    "init_health",
     "ScalarCluster",
+    "HealthOracle",
     "read_index",
     # submodules imported lazily to keep jax-light paths cheap:
     #   .driver    MultiRaft host driver

@@ -29,23 +29,27 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..config import Config
+from ..config import Config, HealthConfig
 from ..eraftpb import Message, MessageType
 from ..errors import RaftError
 from ..raft import StateRole, new_message
 from ..raw_node import RawNode
 from ..storage import Storage
 from . import kernels
+from .health import HealthMonitor
 
 
 class MultiRaft:
     """G RawNodes with device-batched tick timers."""
+
+    _HEALTH_EVERY = 128  # ticks between automatic health-summary records
 
     def __init__(
         self,
         base_config: Config,
         storages: Sequence[Storage],
         group_seeds: Optional[Sequence[int]] = None,
+        health: Optional[HealthConfig] = None,
     ):
         self.G = len(storages)
         self.nodes: List[RawNode] = []
@@ -76,6 +80,42 @@ class MultiRaft:
         self._promotable = np.array(
             [n.raft.promotable for n in self.nodes], bool
         )
+        # Consensus-cursor mirrors feeding the health planes (authoritative
+        # between host events like the timer mirrors above).
+        self._leader = np.array(
+            [n.raft.leader_id for n in self.nodes], np.int64
+        )
+        self._term = np.array([n.raft.term for n in self.nodes], np.int64)
+        self._commit = np.array(
+            [n.raft.raft_log.committed for n in self.nodes], np.int64
+        )
+
+        # Ready-scan short-circuit: groups that MIGHT have readiness.  A
+        # RawNode only becomes ready through a host interaction (tick side
+        # effects, step/propose/advance, or direct node() access), so every
+        # such path marks its group here and ready_groups() probes only the
+        # marked set — idle groups cost zero host work per tick.
+        self._maybe_ready = set(range(self.G))
+
+        # Fleet-health planes (numpy, this node's view of each group).
+        # vote splits are not observable from one peer — that plane lives
+        # on the device sim only (docs/OBSERVABILITY.md "Fleet health").
+        self.health_config = health
+        self.health_monitor: Optional[HealthMonitor] = None
+        if health is not None:
+            health.validate()
+            self.health_monitor = HealthMonitor(
+                metrics=base_config.metrics,
+                recorder_size=health.recorder_size,
+                snapshot_fn=self.explain,
+            )
+            self._h_leaderless = np.zeros(self.G, np.int64)
+            self._h_since_commit = np.zeros(self.G, np.int64)
+            self._h_term_bumps = np.zeros(self.G, np.int64)
+            self._h_prev_commit = self._commit.copy()
+            self._h_prev_term = self._term.copy()
+            self._h_window_pos = 0
+            self._h_ticks = 0
 
         et, ht = self.election_tick, self.heartbeat_tick
 
@@ -99,6 +139,9 @@ class MultiRaft:
         self._hb[g] = r.heartbeat_elapsed
         self._rt[g] = r.randomized_election_timeout
         self._promotable[g] = r.promotable
+        self._leader[g] = r.leader_id
+        self._term[g] = r.term
+        self._commit[g] = r.raft_log.committed
 
     # --- the batched tick (SURVEY.md §7 kernel k1 in production shape) ---
 
@@ -133,9 +176,11 @@ class MultiRaft:
                 sync_seconds=time.perf_counter() - t0,
             )
         if not active.any():
+            self._update_health()
             return active
         for g in np.nonzero(active)[0]:
             g = int(g)
+            self._maybe_ready.add(g)
             node = self.nodes[g]
             r = node.raft
             self._sync_to_node(g)
@@ -164,12 +209,99 @@ class MultiRaft:
                 except RaftError:
                     pass
             self._sync_from_node(g)
+        self._update_health()
         return active
+
+    # --- fleet health (this node's per-group view; numpy planes) ---
+
+    def _update_health(self) -> None:
+        """Per-tick vectorized health fold over the cursor mirrors (no
+        Python per-group loop — this must stay O(G) numpy, not O(G)
+        interpreter).  Units are driver TICKS (the sim planes count
+        protocol rounds)."""
+        hc = self.health_config
+        if hc is None:
+            return
+        has_leader = self._leader != 0
+        self._h_leaderless = np.where(has_leader, 0, self._h_leaderless + 1)
+        advanced = self._commit > self._h_prev_commit
+        self._h_since_commit = np.where(
+            advanced, 0, self._h_since_commit + 1
+        )
+        np.copyto(self._h_prev_commit, self._commit)
+        if self._h_window_pos == 0:
+            self._h_term_bumps[:] = 0
+        self._h_term_bumps += self._term - self._h_prev_term
+        np.copyto(self._h_prev_term, self._term)
+        self._h_window_pos = (self._h_window_pos + 1) % hc.window
+        self._h_ticks += 1
+        if (
+            self.health_monitor is not None
+            and self._h_ticks % self._HEALTH_EVERY == 0
+        ):
+            self.health_monitor.record(self._health_summary())
+
+    def _health_summary(self) -> Dict[str, object]:
+        """The same fixed-size summary shape ClusterSim.health() emits
+        (vote-split facts excluded: not observable from one peer)."""
+        hc = self.health_config
+        assert hc is not None
+        lag = self._h_since_commit
+        leaderless = self._h_leaderless
+        # HEALTH_COUNT_NAMES order (kernels.HS_* indices).
+        counts = [
+            int((leaderless > 0).sum()),
+            int((leaderless >= hc.leaderless_stall_ticks).sum()),
+            int((lag >= hc.commit_stall_ticks).sum()),
+            int((self._h_term_bumps >= hc.churn_bumps).sum()),
+        ]
+        bounds = np.asarray(kernels.LAG_BUCKET_BOUNDS, np.int64)
+        bucket = (lag[:, None] >= bounds[None, :]).sum(axis=1)
+        hist = np.bincount(bucket, minlength=kernels.N_LAG_BUCKETS)
+        score = np.maximum(lag, leaderless)
+        k = min(hc.topk, self.G)
+        order = np.argsort(-score, kind="stable")[:k]
+        return HealthMonitor.summary_dict(counts, hist, order, score[order])
+
+    def health(self) -> Dict[str, object]:
+        """Current fleet-health summary (requires the health=HealthConfig
+        constructor arg); also pushed to the flight recorder."""
+        if self.health_config is None:
+            raise RuntimeError(
+                "health disabled; construct MultiRaft with "
+                "health=HealthConfig(...)"
+            )
+        summary = self._health_summary()
+        if self.health_monitor is not None:
+            self.health_monitor.record(summary)
+        return summary
+
+    def explain(self, group_id: int) -> Dict[str, object]:
+        """Post-mortem for one group: health-plane row + this peer's
+        consensus cursors (worst-offender snapshots in the flight recorder
+        come through here)."""
+        r = self.nodes[group_id].raft
+        out: Dict[str, object] = {
+            "group": int(group_id),
+            "term": int(r.term),
+            "state": int(r.state),
+            "leader_id": int(r.leader_id),
+            "commit": int(r.raft_log.committed),
+            "last_index": int(r.raft_log.last_index()),
+        }
+        if self.health_config is not None:
+            out["health"] = {
+                "leaderless_ticks": int(self._h_leaderless[group_id]),
+                "ticks_since_commit": int(self._h_since_commit[group_id]),
+                "term_bumps_in_window": int(self._h_term_bumps[group_id]),
+            }
+        return out
 
     # --- host-side per-group interactions (all bracketed by sync) ---
 
     def _host_op(self, g: int, fn: Callable[[RawNode], object]):
         self._sync_to_node(g)
+        self._maybe_ready.add(g)
         try:
             return fn(self.nodes[g])
         finally:
@@ -186,6 +318,7 @@ class MultiRaft:
             by_group.setdefault(g, []).append(m)
         for g in sorted(by_group):
             self._sync_to_node(g)
+            self._maybe_ready.add(g)
             for m in by_group[g]:
                 # Inbox delivery ignores protocol step errors only (the DCN
                 # receive path mirrors the harness pump's discipline).
@@ -205,7 +338,26 @@ class MultiRaft:
         return self.nodes[g].has_ready()
 
     def ready_groups(self) -> List[int]:
-        return [g for g, n in enumerate(self.nodes) if n.has_ready()]
+        """Groups with pending readiness.
+
+        Short-circuited by the `_maybe_ready` dirty set: only groups some
+        host interaction touched since they last probed not-ready are
+        scanned — the device fired-masks already tell the tick which groups
+        those are, so a quiescent fleet costs ZERO per-group host work here
+        instead of an O(G) has_ready() sweep.  The scanned/skipped split is
+        recorded on the metrics plane (the skip ratio)."""
+        dirty = self._maybe_ready
+        out: List[int] = []
+        still: set = set()
+        for g in sorted(dirty):
+            if self.nodes[g].has_ready():
+                out.append(g)
+                still.add(g)
+        m = self.metrics
+        if m is not None:
+            m.on_ready_scan(scanned=len(dirty), skipped=self.G - len(dirty))
+        self._maybe_ready = still
+        return out
 
     def ready(self, g: int):
         return self._host_op(g, lambda n: n.ready())
@@ -217,6 +369,9 @@ class MultiRaft:
         self._host_op(g, lambda n: n.advance_apply())
 
     def node(self, g: int) -> RawNode:
+        # Handing out the RawNode lets the caller mutate it behind our
+        # back, so conservatively mark the group for the next ready scan.
+        self._maybe_ready.add(g)
         return self.nodes[g]
 
     # --- batched introspection (SURVEY.md §5.5 MultiRaftStatus) ---

@@ -1,0 +1,123 @@
+"""HealthMonitor: the host-side consumer of fleet-health summaries.
+
+The device planes (raft_tpu/multiraft/kernels.py HP_* rows, maintained by
+sim.step) and the MultiRaft driver's numpy planes both reduce to the same
+fixed-size summary dict::
+
+    {"counts": {"leaderless": n, "stalled_leaderless": n,
+                "commit_stalled": n, "churning": n},
+     "lag_hist": [kernels.N_LAG_BUCKETS counts],
+     "worst": [{"group": id, "score": s}, ...]}
+
+This module is the boundary where those summaries land on the host: the
+monitor converts each one into Prometheus gauges via the PR 1 registry
+(raft_tpu.metrics.Metrics.on_health_summary), emits `health.*` events
+through the EventTracer, and keeps a fixed-size flight-recorder ring of
+recent summaries plus per-worst-group state snapshots for post-mortems
+(MultiRaft.explain / ClusterSim.explain feed the snapshot hook).
+
+Summaries must arrive as plain host dicts — this module is in graftcheck's
+GC002 scope precisely so no device sync (device_get/.item()) can creep
+into the record path, and in GC004's scope so every metrics call stays
+behind the single enabled-check branch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+__all__ = ["HealthMonitor"]
+
+
+class HealthMonitor:
+    """Flight recorder + metrics/tracing bridge for health summaries.
+
+    metrics:       optional raft_tpu.metrics.Metrics; each recorded summary
+                   is published through on_health_summary and traced.
+    recorder_size: ring capacity (config.HealthConfig.recorder_size).
+    snapshot_fn:   optional group_id -> dict hook; when set, worst-offender
+                   groups with a non-zero score get a state snapshot stored
+                   alongside the summary (the owners install their explain()
+                   here — ClusterSim and MultiRaft both do).
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        recorder_size: int = 64,
+        snapshot_fn: Optional[Callable[[int], dict]] = None,
+    ):
+        self.metrics = metrics
+        self.snapshot_fn = snapshot_fn
+        self._ring: Deque[dict] = deque(maxlen=recorder_size)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def summary_dict(counts, lag_hist, worst_ids, worst_scores) -> dict:
+        """THE summary shape (module docstring) from the four reduction
+        vectors, in kernels.health_summary's return order — the single
+        formatter every producer (ClusterSim, MultiRaft, bench.py) goes
+        through so the consumers can never see a drifted shape."""
+        from .kernels import HEALTH_COUNT_NAMES
+
+        return {
+            "counts": dict(
+                zip(HEALTH_COUNT_NAMES, (int(v) for v in counts))
+            ),
+            "lag_hist": [int(v) for v in lag_hist],
+            "worst": [
+                {"group": int(g), "score": int(s)}
+                for g, s in zip(worst_ids, worst_scores)
+            ],
+        }
+
+    def record(self, summary: dict) -> dict:
+        """Fold one summary into the recorder, metrics, and trace; returns
+        the flight-recorder entry (with its seq / ts / snapshots)."""
+        snapshots: Dict[int, dict] = {}
+        fn = self.snapshot_fn
+        if fn is not None:
+            for w in summary.get("worst", ()):
+                if w["score"] > 0:
+                    snapshots[w["group"]] = fn(w["group"])
+        with self._lock:
+            entry = {"seq": self._seq, "ts": time.time(), "summary": summary}
+            if snapshots:
+                entry["worst_snapshots"] = snapshots
+            self._seq += 1
+            self._ring.append(entry)
+        m = self.metrics
+        if m is not None:
+            m.on_health_summary(summary)
+            counts = summary.get("counts", {})
+            m.trace("health.summary", **counts)
+            if counts.get("stalled_leaderless", 0) or counts.get(
+                "commit_stalled", 0
+            ):
+                m.trace(
+                    "health.stall",
+                    stalled_leaderless=counts.get("stalled_leaderless", 0),
+                    commit_stalled=counts.get("commit_stalled", 0),
+                    worst=summary.get("worst", []),
+                )
+            if counts.get("churning", 0):
+                m.trace("health.churn", churning=counts.get("churning", 0))
+        return entry
+
+    def last(self) -> Optional[dict]:
+        """Most recent flight-recorder entry, or None."""
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def flight_recorder(self) -> List[dict]:
+        """Oldest-to-newest copy of the recorder ring."""
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)

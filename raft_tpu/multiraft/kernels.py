@@ -27,6 +27,14 @@ here is missing from it or untested under tests/.
   zero_counters /          <-> the device mirror of raft_tpu.metrics event
   count_events                 counters (no reference analog; parity vs the
                                scalar counts in tests/test_counter_parity.py)
+  zero_health /            <-> the device fleet-health planes (no reference
+  update_health                analog; per-round parity vs the scalar
+                               HealthOracle in tests/test_health_parity.py)
+  health_summary           <-> on-device reduction of the health planes to a
+                               fixed-size summary (threshold counts, commit-
+                               lag histogram, lax.top_k worst offenders);
+                               parity vs a host argsort in
+                               tests/test_health_parity.py
 
 TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
 committed_index is a fixed-width masked sort along the last axis that XLA
@@ -280,6 +288,129 @@ def count_events(
         ]
     ).astype(counters.dtype)
     return counters + events
+
+
+# --- device-side fleet-health planes (the per-group observability layer) --
+#
+# Row indices into the [N_HEALTH_PLANES, G] int32 plane stack that
+# `sim.step` maintains when given a health state: per-GROUP liveness
+# telemetry (the counter plane above answers "how much happened in total";
+# these answer "which groups are unhealthy right now") kept entirely on
+# device so the GC002 no-host-sync invariant holds — only the fixed-size
+# `health_summary` reduction ever crosses to the host.  Exact per-round
+# parity against the scalar oracle (simref.HealthOracle) is asserted by
+# tests/test_health_parity.py.
+HP_LEADERLESS = 0  # consecutive rounds the group ended with no alive leader
+HP_SINCE_COMMIT = 1  # consecutive rounds the group's max commit was flat
+HP_TERM_BUMPS = 2  # max-term growth inside the current churn window
+HP_VOTE_SPLITS = 3  # cumulative election rounds that elected nobody
+N_HEALTH_PLANES = 4
+
+HEALTH_PLANE_NAMES = (
+    "leaderless_ticks",
+    "ticks_since_commit",
+    "term_bumps_in_window",
+    "vote_splits",
+)
+
+# Commit-lag histogram bucket lower bounds (ticks_since_commit); bucket i
+# counts groups with LAG_BUCKET_BOUNDS[i-1] <= lag < LAG_BUCKET_BOUNDS[i],
+# bucket 0 is lag == 0 and the last bucket is lag >= 64.
+LAG_BUCKET_BOUNDS = (1, 2, 4, 8, 16, 32, 64)
+N_LAG_BUCKETS = len(LAG_BUCKET_BOUNDS) + 1
+
+# health_summary count-vector indices.
+HS_LEADERLESS = 0  # groups currently leaderless (any duration)
+HS_STALLED_LEADERLESS = 1  # leaderless at/over the stall threshold
+HS_COMMIT_STALLED = 2  # commit-flat at/over the stall threshold
+HS_CHURNING = 3  # term bumps in window at/over the churn threshold
+N_HEALTH_COUNTS = 4
+
+HEALTH_COUNT_NAMES = (
+    "leaderless",
+    "stalled_leaderless",
+    "commit_stalled",
+    "churning",
+)
+
+
+def zero_health(n_groups: int) -> jnp.ndarray:
+    """Fresh [N_HEALTH_PLANES, n_groups] int32 health-plane stack."""
+    return jnp.zeros((N_HEALTH_PLANES, n_groups), jnp.int32)
+
+
+def update_health(
+    planes: jnp.ndarray,
+    window_pos: jnp.ndarray,
+    window: int,
+    has_leader: jnp.ndarray,
+    commit_advanced: jnp.ndarray,
+    term_bump: jnp.ndarray,
+    vote_split: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold one protocol round into the health planes.
+
+    planes:          [N_HEALTH_PLANES, G] int32 (see HP_* indices)
+    window_pos:      int32 scalar, rounds into the current churn window
+    window:          python int, churn-window length in rounds (static)
+    has_leader:      bool[G]  group ended the round with an alive leader
+    commit_advanced: bool[G]  group max commit index grew this round
+    term_bump:       int32[G] group max term growth this round
+    vote_split:      bool[G]  a campaign fired this round but nobody won
+
+    Returns (planes', window_pos').  The churn window resets at the START
+    of the round whose window_pos is 0, so `term_bumps_in_window` always
+    covers the last (window_pos or window) rounds.
+    """
+    leaderless = jnp.where(has_leader, 0, planes[HP_LEADERLESS] + 1)
+    since = jnp.where(commit_advanced, 0, planes[HP_SINCE_COMMIT] + 1)
+    fresh = window_pos == 0
+    bumps = jnp.where(fresh, 0, planes[HP_TERM_BUMPS]) + term_bump
+    splits = planes[HP_VOTE_SPLITS] + vote_split.astype(jnp.int32)
+    new_pos = (window_pos + 1) % jnp.int32(window)
+    return jnp.stack([leaderless, since, bumps, splits]), new_pos
+
+
+def health_summary(
+    planes: jnp.ndarray,
+    stall_ticks: int,
+    commit_stall_ticks: int,
+    churn_bumps: int,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """On-device reduction of the health planes to a fixed-size summary.
+
+    Returns (counts[N_HEALTH_COUNTS], lag_hist[N_LAG_BUCKETS],
+    worst_ids[k], worst_scores[k]) — all int32, O(k + buckets) bytes across
+    the host boundary regardless of G.
+
+    The worst-offender score is max(ticks_since_commit, leaderless_ticks);
+    `jax.lax.top_k` breaks ties toward the LOWER group id, matching a
+    stable host-side argsort of the negated score
+    (tests/test_health_parity.py).
+    """
+    leaderless = planes[HP_LEADERLESS]
+    lag = planes[HP_SINCE_COMMIT]
+    bumps = planes[HP_TERM_BUMPS]
+    counts = jnp.stack(
+        [
+            jnp.sum((leaderless > 0).astype(jnp.int32)),
+            jnp.sum((leaderless >= stall_ticks).astype(jnp.int32)),
+            jnp.sum((lag >= commit_stall_ticks).astype(jnp.int32)),
+            jnp.sum((bumps >= churn_bumps).astype(jnp.int32)),
+        ]
+    )
+    bounds = jnp.asarray(LAG_BUCKET_BOUNDS, jnp.int32)
+    bucket = jnp.sum((lag[:, None] >= bounds[None, :]).astype(jnp.int32), axis=1)
+    hist = jnp.zeros((N_LAG_BUCKETS,), jnp.int32).at[bucket].add(1)
+    score = jnp.maximum(lag, leaderless)
+    worst_scores, worst_ids = jax.lax.top_k(score, k)
+    return (
+        counts,
+        hist,
+        worst_ids.astype(jnp.int32),
+        worst_scores.astype(jnp.int32),
+    )
 
 
 def tick_kernel(

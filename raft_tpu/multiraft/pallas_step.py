@@ -29,46 +29,46 @@ which is exactly the lockstep schedule ScalarCluster/bench drive).
 from __future__ import annotations
 
 import functools
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import sim as sim_mod
-from .kernels import ROLE_LEADER
-from .sim import SimConfig, SimState
+from .kernels import (
+    HP_SINCE_COMMIT,
+    HP_TERM_BUMPS,
+    HP_VOTE_SPLITS,
+    ROLE_LEADER,
+)
+from .sim import HealthState, SimConfig, SimState
 
 BLOCK = 8192
 
 
 def _steady_kernel(
-    # inputs
-    state_ref,
-    term_ref,
-    ee_ref,
-    hb_ref,
-    li_ref,
-    lt_ref,
-    matched_ref,
-    commit_ref,
-    voter_ref,
-    member_ref,
-    crashed_ref,
-    ts_ref,
-    app_ref,
-    # outputs
-    ee_out,
-    hb_out,
-    li_out,
-    lt_out,
-    matched_out,
-    commit_out,
-    *,
+    # inputs: state_ref, term_ref, ee_ref, hb_ref, li_ref, lt_ref,
+    # matched_ref, commit_ref, voter_ref, member_ref, crashed_ref, ts_ref,
+    # app_ref [+ tsc_ref when with_health]; then the outputs: ee, hb, li,
+    # lt, matched, commit [+ tsc].  Flat *refs because the health variant
+    # adds one input/output pair and pallas kernels take refs positionally.
+    *refs,
     P: int,
     rounds: int,
     election_tick: int,
     heartbeat_tick: int,
+    with_health: bool,
 ):
+    n_in = 14 if with_health else 13
+    (
+        state_ref, term_ref, ee_ref, hb_ref, li_ref, lt_ref, matched_ref,
+        commit_ref, voter_ref, member_ref, crashed_ref, ts_ref, app_ref,
+    ) = refs[:13]
+    ee_out, hb_out, li_out, lt_out, matched_out, commit_out = refs[
+        n_in : n_in + 6
+    ]
     state = state_ref[...]
     term = term_ref[...]
     ee = ee_ref[...]
@@ -82,6 +82,9 @@ def _steady_kernel(
     crashed = crashed_ref[...] != 0
     term_start = ts_ref[...]  # [1, BLOCK]
     app = app_ref[...]  # [1, BLOCK]
+    if with_health:
+        tsc = refs[13][...]  # [1, BLOCK] ticks_since_commit plane
+        maxc_prev = jnp.max(commit, axis=0, keepdims=True)  # [1, BLOCK]
 
     alive = ~crashed
     # Timers tick by ROLE — a crashed (isolated) leader keeps ticking
@@ -143,18 +146,44 @@ def _steady_kernel(
         )
         commit = jnp.where((is_leader | sync) & sent, lead_commit, commit)
 
+        if with_health:
+            # The one health plane a steady round can move: per-round
+            # commit-advance tracking for ticks_since_commit (the other
+            # planes are closed-form over a steady horizon — see
+            # steady_round's health wrapper).
+            maxc = jnp.max(commit, axis=0, keepdims=True)
+            tsc = jnp.where(maxc > maxc_prev, 0, tsc + 1)
+            maxc_prev = maxc
+
     ee_out[...] = ee
     hb_out[...] = hb
     li_out[...] = li
     lt_out[...] = lt
     matched_out[...] = matched
     commit_out[...] = commit
+    if with_health:
+        refs[n_in + 6][...] = tsc
 
 
-def steady_round(cfg: SimConfig, rounds: int = 1):
+def steady_round(
+    cfg: SimConfig,
+    rounds: int = 1,
+    with_health: bool = False,
+    interpret: bool = False,
+):
     """Build the pallas_call for `rounds` fused steady protocol rounds;
     returns fn(st, crashed, append_n) -> SimState (same crashed/append each
-    round)."""
+    round).
+
+    With `with_health`, the returned fn is fn(st, crashed, append_n,
+    health) -> (SimState, HealthState), bit-identical to threading
+    sim.step's health extra through the same rounds.  Only
+    ticks_since_commit needs per-round tracking (one extra [1, BLOCK] VMEM
+    plane); the other planes are closed-form over a steady horizon — no
+    campaigns can fire and the alive leader holds, so leaderless_ticks
+    lands at 0, vote_splits is unchanged, term bumps are 0 and the churn
+    window only needs its position advanced (with one reset if a window
+    boundary falls inside the horizon)."""
     P = cfg.n_peers
     G = cfg.n_groups
     block = min(BLOCK, G)
@@ -169,17 +198,38 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
         rounds=rounds,
         election_tick=cfg.election_tick,
         heartbeat_tick=cfg.heartbeat_tick,
+        with_health=with_health,
     )
 
+    n_g_in = 3 if with_health else 2
+    n_out = 7 if with_health else 6
+    out_shape = [jax.ShapeDtypeStruct((P, G), jnp.int32)] * 6
+    out_specs = [pg_spec] * 6
+    if with_health:
+        out_shape = out_shape + [jax.ShapeDtypeStruct((1, G), jnp.int32)]
+        out_specs = out_specs + [g_spec]
+    del n_out
+
+    # `interpret` is for CPU runs with no Mosaic lowering (bench artifact
+    # jobs).  Only passed when set: the test fixtures patch pl.pallas_call
+    # with setdefault("interpret", True), which an explicit False would
+    # defeat.
+    interp_kw = {"interpret": True} if interpret else {}
     call = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[pg_spec] * 11 + [g_spec] * 2,
-        out_specs=[pg_spec] * 6,
-        out_shape=[jax.ShapeDtypeStruct((P, G), jnp.int32)] * 6,
+        in_specs=[pg_spec] * 11 + [g_spec] * n_g_in,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        **interp_kw,
     )
 
-    def fn(st: SimState, crashed: jnp.ndarray, append_n: jnp.ndarray) -> SimState:
+    def _run(
+        st: SimState,
+        crashed: jnp.ndarray,
+        append_n: jnp.ndarray,
+        tsc_in: Optional[jnp.ndarray],
+    ):
         # The acting leader is fixed for the whole steady horizon (no
         # elections, constant crash mask), so its tracker row is gathered
         # once outside the kernel and scattered back after.
@@ -188,7 +238,7 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
         acting_row = jnp.sum(st.matched * f[:, None, :], axis=0)  # [P, G]
         ts_acting = jnp.sum(st.term_start_index * f, axis=0)  # [G]
 
-        ee, hb, li, lt, new_row, commit = call(
+        inputs = (
             st.state,
             st.term,
             st.election_elapsed,
@@ -203,6 +253,11 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
             ts_acting[None, :],
             append_n[None, :],
         )
+        if tsc_in is not None:
+            inputs = inputs + (tsc_in[None, :],)
+        outs = call(*inputs)
+        ee, hb, li, lt, new_row, commit = outs[:6]
+        tsc_out = outs[6][0] if tsc_in is not None else None
         matched = jnp.where(
             is_leader[:, None, :], new_row[None, :, :], st.matched
         )
@@ -222,7 +277,7 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
                 jnp.where(in_s[None, :, :], lead_row[:, None, :], st.agree),
             ),
         )
-        return st._replace(
+        out = st._replace(
             election_elapsed=ee,
             heartbeat_elapsed=hb,
             last_index=li,
@@ -231,8 +286,40 @@ def steady_round(cfg: SimConfig, rounds: int = 1):
             commit=commit,
             agree=agree,
         )
+        return out, tsc_out
 
-    return fn
+    def fn(
+        st: SimState, crashed: jnp.ndarray, append_n: jnp.ndarray
+    ) -> SimState:
+        return _run(st, crashed, append_n, None)[0]
+
+    def fn_health(
+        st: SimState,
+        crashed: jnp.ndarray,
+        append_n: jnp.ndarray,
+        health: HealthState,
+    ):
+        out, tsc_out = _run(
+            st, crashed, append_n, health.planes[HP_SINCE_COMMIT]
+        )
+        # Closed-form health fold for a steady horizon (see the docstring):
+        # the churn window resets iff a round with window_pos == 0 falls
+        # inside [pos, pos + rounds), and every in-horizon bump is 0.
+        pos = health.window_pos
+        window = jnp.int32(cfg.health_window)
+        crossed = (pos == 0) | (pos + jnp.int32(rounds) > window)
+        planes = jnp.stack(
+            [
+                jnp.zeros_like(tsc_out),  # leaderless: a leader held all k
+                tsc_out,
+                jnp.where(crossed, 0, health.planes[HP_TERM_BUMPS]),
+                health.planes[HP_VOTE_SPLITS],
+            ]
+        )
+        new_pos = (pos + jnp.int32(rounds)) % window
+        return out, HealthState(planes, new_pos)
+
+    return fn_health if with_health else fn
 
 
 def steady_mask(
@@ -285,10 +372,26 @@ def steady_predicate(
     return jnp.all(steady_mask(cfg, st, crashed, horizon))
 
 
-def fast_step(cfg: SimConfig):
+def fast_step(cfg: SimConfig, with_health: bool = False):
     """Dispatcher: the fused pallas round when steady, the general XLA step
-    otherwise.  Same signature/semantics as sim.step."""
-    pallas_fn = steady_round(cfg, rounds=1)
+    otherwise.  Same signature/semantics as sim.step; with `with_health`
+    the fn takes/returns a HealthState extra exactly like sim.step's."""
+    pallas_fn = steady_round(cfg, rounds=1, with_health=with_health)
+
+    if with_health:
+
+        def fn_health(st: SimState, crashed, append_n, health):
+            pred = steady_predicate(cfg, st, crashed, horizon=1)
+            return jax.lax.cond(
+                pred,
+                lambda args: pallas_fn(*args),
+                lambda args: sim_mod.step(
+                    cfg, args[0], args[1], args[2], health=args[3]
+                ),
+                (st, crashed, append_n, health),
+            )
+
+        return fn_health
 
     def fn(st: SimState, crashed, append_n) -> SimState:
         pred = steady_predicate(cfg, st, crashed, horizon=1)
@@ -302,12 +405,47 @@ def fast_step(cfg: SimConfig):
     return fn
 
 
-def fast_multi_round(cfg: SimConfig, k: int = 16):
+def fast_multi_round(
+    cfg: SimConfig,
+    k: int = 16,
+    with_health: bool = False,
+    interpret: bool = False,
+):
     """Dispatcher advancing k protocol rounds per call (same crashed/append
     every round): the k-fused pallas kernel when provably steady for the
     whole horizon, else k sequential general steps.  Semantically identical
-    to calling sim.step k times."""
-    pallas_fn = steady_round(cfg, rounds=k)
+    to calling sim.step k times.
+
+    With `with_health`, fn(st, crashed, append_n, health) -> (SimState,
+    HealthState): both branches thread the health planes, so per-round
+    health parity holds whichever branch runs (tests/test_pallas_step.py).
+    """
+    pallas_fn = steady_round(
+        cfg, rounds=k, with_health=with_health, interpret=interpret
+    )
+
+    if with_health:
+
+        def slow_health(args):
+            st, crashed, append_n, health = args
+
+            def body(carry, _):
+                s, h = carry
+                s, h = sim_mod.step(cfg, s, crashed, append_n, health=h)
+                return (s, h), ()
+
+            return jax.lax.scan(body, (st, health), None, length=k)[0]
+
+        def fn_health(st: SimState, crashed, append_n, health):
+            pred = steady_predicate(cfg, st, crashed, horizon=k)
+            return jax.lax.cond(
+                pred,
+                lambda args: pallas_fn(*args),
+                slow_health,
+                (st, crashed, append_n, health),
+            )
+
+        return fn_health
 
     def slow(args):
         st, crashed, append_n = args
@@ -346,7 +484,12 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
 
     Falls back to k general steps on the whole batch only when more than
     `storm_slots` groups are non-steady (mass storms: elections at boot,
-    correlated failures)."""
+    correlated failures).
+
+    Health planes are NOT threaded here (use fast_multi_round(...,
+    with_health=True) or the general step): the storm split would need a
+    per-sub-batch window-position fork that the closed-form steady fold
+    cannot express."""
     G = cfg.n_groups
     S = min(storm_slots, G)
     pallas_fn = steady_round(cfg, rounds=k)

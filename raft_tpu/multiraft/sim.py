@@ -71,6 +71,22 @@ class SimConfig(NamedTuple):
     # via ClusterSim.counters().  Compile-time static: the disabled graph is
     # bit-identical to pre-observability builds.
     collect_counters: bool = False
+    # Fleet-health toggle: when True, ClusterSim threads the per-group
+    # [kernels.N_HEALTH_PLANES, G] health planes through the jitted step
+    # (kernels.update_health) and reduces them on device
+    # (kernels.health_summary) so only a fixed-size summary ever crosses to
+    # the host.  Compile-time static like collect_counters.
+    collect_health: bool = False
+    # Churn window (rounds): term_bumps_in_window covers at most this many
+    # trailing rounds before resetting.
+    health_window: int = 32
+    # Summary thresholds (rounds / bumps-per-window): a group counts as
+    # stalled/churning when the plane value is AT or OVER the threshold.
+    leaderless_stall_ticks: int = 16
+    commit_stall_ticks: int = 32
+    churn_bumps: int = 4
+    # Worst-offender extraction width (jax.lax.top_k k).
+    health_topk: int = 8
 
     @property
     def min_timeout(self) -> int:
@@ -121,6 +137,29 @@ class SimState(NamedTuple):
     # Learners (reference: tracker.rs:40-49): replicated to, never voting,
     # never campaigning, never counted in quorums.
     learner_mask: jnp.ndarray  # [P, G]
+
+
+class HealthState(NamedTuple):
+    """Device-resident fleet-health telemetry carried alongside SimState.
+
+    planes:     [kernels.N_HEALTH_PLANES, G] int32 per-group planes (row
+                indices kernels.HP_*); updated once per step by
+                kernels.update_health, downloaded never — only the
+                kernels.health_summary reduction crosses to the host.
+    window_pos: int32 scalar, rounds into the current churn window; the
+                term-bump plane resets when it wraps to 0.
+    """
+
+    planes: jnp.ndarray
+    window_pos: jnp.ndarray
+
+
+def init_health(cfg: SimConfig) -> HealthState:
+    """Fresh all-zero health state for a sim of cfg.n_groups groups."""
+    return HealthState(
+        planes=kernels.zero_health(cfg.n_groups),
+        window_pos=jnp.int32(0),
+    )
 
 
 def _node_key(
@@ -222,7 +261,8 @@ def step(
     append_n: jnp.ndarray,
     group_ids: Optional[jnp.ndarray] = None,
     counters: Optional[jnp.ndarray] = None,
-) -> Union[SimState, Tuple[SimState, jnp.ndarray]]:
+    health: Optional[HealthState] = None,
+) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
     crashed:  bool[P, G] peers isolated this round (keep ticking, no I/O)
@@ -231,9 +271,16 @@ def step(
                sub-batch (keeps the per-(group, term) timeout PRNG global)
     counters: optional [kernels.N_COUNTERS] int32 accumulator plane; when
                given, this round's event counts (campaigns, heartbeats,
-               elections won, commit entries) are folded in on-device and
-               the return value becomes (state, counters).  The choice is
-               trace-time static: the counters=None graph is unchanged.
+               elections won, commit entries) are folded in on-device.
+    health:   optional HealthState; when given, this round's per-group
+               health facts (alive-leader presence, commit advance, term
+               bumps, vote splits) are folded into the planes on-device
+               (kernels.update_health).
+
+    Extras are appended to the return value in (counters, health) order for
+    whichever are given — (state,), (state, counters), (state, health), or
+    (state, counters, health); bare `state` when neither.  Both choices are
+    trace-time static: the counters=None/health=None graph is unchanged.
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -671,17 +718,41 @@ def step(
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
     )
-    if counters is None:
+    if counters is None and health is None:
         return out
-    # Device-side event counting, fused into this same dispatch.  A group
-    # wins at most one election per round (quorum uniqueness), and the solo
-    # crashed-campaigner path is mutually exclusive with the networked one,
-    # so `winner_exists | any(solo_win)` is exactly the become_leader count.
+    # A group wins at most one election per round (quorum uniqueness), and
+    # the solo crashed-campaigner path is mutually exclusive with the
+    # networked one, so `winner_exists | any(solo_win)` is exactly the
+    # become_leader count.
     won_any = winner_exists | jnp.any(solo_win, axis=0)
-    counters = kernels.count_events(
-        counters, want_campaign, want_heartbeat, won_any, commit - st.commit
-    )
-    return out, counters
+    extras: Tuple = ()
+    if counters is not None:
+        # Device-side event counting, fused into this same dispatch.
+        counters = kernels.count_events(
+            counters, want_campaign, want_heartbeat, won_any, commit - st.commit
+        )
+        extras = extras + (counters,)
+    if health is not None:
+        # Device-side per-group health fold, fused into this same dispatch.
+        # All facts are derived from the round's (pre, post) state pair plus
+        # the in-flight election masks; the scalar oracle computes the
+        # identical facts from observable scalar state
+        # (simref.HealthOracle — exact parity, tests/test_health_parity.py).
+        has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        campaigned = jnp.any(want_campaign, axis=0)
+        planes, pos = kernels.update_health(
+            health.planes,
+            health.window_pos,
+            cfg.health_window,
+            has_lead_end,
+            commit_adv,
+            term_bump,
+            campaigned & ~won_any,
+        )
+        extras = extras + (HealthState(planes, pos),)
+    return (out,) + extras
 
 
 def read_index(
@@ -751,12 +822,26 @@ class ClusterSim:
         voter_mask: Optional[jnp.ndarray] = None,
         outgoing_mask: Optional[jnp.ndarray] = None,
         learner_mask: Optional[jnp.ndarray] = None,
+        health_monitor=None,
     ):
         self.cfg = cfg
         self.state = init_state(cfg, voter_mask, outgoing_mask, learner_mask)
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
         self._counters: Optional[jnp.ndarray] = None
         self._step_counted = None
+        self._health: Optional[HealthState] = None
+        # Host-side summary consumer (multiraft.health.HealthMonitor):
+        # receives the fixed-size summary dict on the drain cadence.
+        self.health_monitor = health_monitor
+        if (
+            health_monitor is not None
+            and cfg.collect_health
+            and health_monitor.snapshot_fn is None
+        ):
+            # Flight-recorder post-mortems snapshot worst groups through us.
+            health_monitor.snapshot_fn = self.explain
+        self._rounds_since_drain = 0
+        self._drain_every = self._DRAIN_MAX
         if cfg.collect_counters:
             self._counters = kernels.zero_counters()
             # The device plane is int32 (TPUs have no native int64), so on
@@ -771,7 +856,6 @@ class ClusterSim:
             # round accruing >= 2**31 events — a rate at which the int32
             # SimState.commit plane itself would overflow within the run.
             self._host_counters = [0] * kernels.N_COUNTERS
-            self._rounds_since_drain = 0
             self._drain_every = 1
             self._drain_cap = max(
                 1, min(self._DRAIN_MAX, (1 << 31) // (256 * cfg.n_groups))
@@ -781,6 +865,34 @@ class ClusterSim:
                 return step(cfg, st, crashed, append_n, counters=ctrs)
 
             self._step_counted = jax.jit(_counted, donate_argnums=(0, 3))
+        if cfg.collect_health:
+            self._health = init_health(cfg)
+            k = min(cfg.health_topk, cfg.n_groups)
+
+            def _summarize(planes):
+                return kernels.health_summary(
+                    planes,
+                    cfg.leaderless_stall_ticks,
+                    cfg.commit_stall_ticks,
+                    cfg.churn_bumps,
+                    k,
+                )
+
+            self._summary_fn = jax.jit(_summarize)
+
+            def _healthy(st, crashed, append_n, health):
+                return step(cfg, st, crashed, append_n, health=health)
+
+            self._step_health = jax.jit(_healthy, donate_argnums=(0, 3))
+            if cfg.collect_counters:
+
+                def _both(st, crashed, append_n, ctrs, health):
+                    return step(
+                        cfg, st, crashed, append_n,
+                        counters=ctrs, health=health,
+                    )
+
+                self._step_both = jax.jit(_both, donate_argnums=(0, 3, 4))
 
     _DRAIN_MAX = 128  # never let a window exceed this many rounds
 
@@ -809,21 +921,42 @@ class ClusterSim:
         self._counters = kernels.zero_counters()
         self._rounds_since_drain = 0
 
+    def _drain(self) -> None:
+        """Periodic host boundary: counter totals fold into the unbounded
+        host accumulator, and — when a monitor is attached — the fixed-size
+        health summary is pushed to it.  Both ride the same adaptive
+        cadence (the PR 1 drain), so health adds no extra sync points."""
+        if self._counters is not None:
+            self._drain_counters()
+        if self._health is not None and self.health_monitor is not None:
+            self.health_monitor.record(self._health_summary_dict())
+        self._rounds_since_drain = 0
+
     def run_round(self, crashed=None, append_n=None) -> SimState:
         G, P = self.cfg.n_groups, self.cfg.n_peers
         if crashed is None:
             crashed = jnp.zeros((P, G), bool)
         if append_n is None:
             append_n = jnp.zeros((G,), jnp.int32)
-        if self._step_counted is not None:
+        cc, ch = self._counters is not None, self._health is not None
+        if cc and ch:
+            self.state, self._counters, self._health = self._step_both(
+                self.state, crashed, append_n, self._counters, self._health
+            )
+        elif cc:
             self.state, self._counters = self._step_counted(
                 self.state, crashed, append_n, self._counters
             )
-            self._rounds_since_drain += 1
-            if self._rounds_since_drain >= self._drain_every:
-                self._drain_counters()
+        elif ch:
+            self.state, self._health = self._step_health(
+                self.state, crashed, append_n, self._health
+            )
         else:
             self.state = self._step(self.state, crashed, append_n)
+            return self.state
+        self._rounds_since_drain += 1
+        if self._rounds_since_drain >= self._drain_every:
+            self._drain()
         return self.state
 
     def run(self, rounds: int, crashed=None, append_n=None) -> SimState:
@@ -853,6 +986,84 @@ class ClusterSim:
             self._counters = kernels.zero_counters()
             self._host_counters = [0] * kernels.N_COUNTERS
             self._rounds_since_drain = 0
+
+    # --- fleet health (requires SimConfig(collect_health=True)) ---
+
+    def _require_health(self) -> HealthState:
+        if self._health is None:
+            raise RuntimeError(
+                "health planes disabled; construct with "
+                "SimConfig(collect_health=True)"
+            )
+        return self._health
+
+    def _health_summary_dict(self) -> dict:
+        """Reduce the device planes to the fixed-size summary and download
+        it — O(topk + buckets) bytes regardless of n_groups."""
+        from .health import HealthMonitor
+
+        h = self._require_health()
+        summary = self._summary_fn(h.planes)
+        # graftcheck: allow-no-host-sync-in-jit — deliberate host-side
+        # drain of the FIXED-SIZE summary (never the [., G] planes), on the
+        # adaptive cadence / on demand, outside the jitted step.
+        counts, hist, ids, scores = jax.device_get(summary)
+        return HealthMonitor.summary_dict(counts, hist, ids, scores)
+
+    def health(self) -> dict:
+        """Current fleet-health summary as a plain dict:
+
+          counts:   {leaderless, stalled_leaderless, commit_stalled,
+                     churning} group counts vs the SimConfig thresholds
+          lag_hist: [kernels.N_LAG_BUCKETS] commit-lag histogram
+          worst:    top-k worst offenders [{group, score}, ...], score =
+                    max(ticks_since_commit, leaderless_ticks)
+
+        The reduction runs on device; only the summary is downloaded.  The
+        summary is also pushed to the attached HealthMonitor (if any)."""
+        summary = self._health_summary_dict()
+        if self.health_monitor is not None:
+            self.health_monitor.record(summary)
+        return summary
+
+    def explain(self, group_id: int) -> dict:
+        """Post-mortem for ONE group: its health-plane row plus every
+        peer's consensus cursors.  On-demand host download of O(P) values —
+        never part of the hot loop."""
+        h = self._require_health()
+        # graftcheck: allow-no-host-sync-in-jit — deliberate on-demand
+        # post-mortem download of one group's column, outside the step.
+        planes = jax.device_get(h.planes[:, group_id])
+        st = self.state
+        # graftcheck: allow-no-host-sync-in-jit — same on-demand post-mortem
+        # download (one [P] column per plane), outside the jitted step.
+        cols = jax.device_get(
+            (
+                st.term[:, group_id],
+                st.state[:, group_id],
+                st.commit[:, group_id],
+                st.last_index[:, group_id],
+                st.leader_id[:, group_id],
+            )
+        )
+        term, role, commit, last_index, leader_id = cols
+        return {
+            "group": int(group_id),
+            "health": dict(
+                zip(kernels.HEALTH_PLANE_NAMES, (int(v) for v in planes))
+            ),
+            "peers": {
+                "term": [int(v) for v in term],
+                "state": [int(v) for v in role],
+                "commit": [int(v) for v in commit],
+                "last_index": [int(v) for v in last_index],
+                "leader_id": [int(v) for v in leader_id],
+            },
+        }
+
+    def reset_health(self) -> None:
+        if self._health is not None:
+            self._health = init_health(self.cfg)
 
     def read_index(self, crashed=None) -> jnp.ndarray:
         """Batched linearizable ReadIndex barrier (see sim.read_index)."""

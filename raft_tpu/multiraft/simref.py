@@ -140,3 +140,96 @@ class ScalarCluster:
                 out["last_index"][g, p] = r.raft_log.last_index()
                 out["last_term"][g, p] = r.raft_log.last_term()
         return out
+
+
+class HealthOracle:
+    """Scalar-side oracle for the device health planes (sim.HealthState).
+
+    Maintains the same four per-group int32 planes — leaderless_ticks,
+    ticks_since_commit, term_bumps_in_window, vote_splits (row order
+    kernels.HP_*) — from OBSERVABLE scalar-cluster state, with the
+    bit-identical fold rules of kernels.update_health:
+
+      * has_leader:      some alive peer ends the round as Leader;
+      * commit_advanced: the group's max commit index grew this round;
+      * term_bump:       growth of the group's max term this round;
+      * campaigned:      some peer's election timer fires this round —
+                         computed BEFORE the round from the same facts as
+                         kernels.tick_kernel (not-leader & promotable &
+                         election_elapsed + 1 >= randomized timeout,
+                         reference: raft.rs:1037-1047);
+      * won:             some peer became leader during the round (Leader
+                         at round end with a new term or a non-Leader
+                         pre-round role — become_leader is the only path);
+      * vote_split:      campaigned and nobody won.
+
+    tests/test_health_parity.py asserts exact per-round equality of these
+    planes against ClusterSim's device-maintained HealthState.
+    """
+
+    def __init__(self, cluster: ScalarCluster, window: int = 32):
+        self.cluster = cluster
+        G = cluster.n_groups
+        self.planes = np.zeros((4, G), dtype=np.int32)
+        self.window = window
+        self.window_pos = 0
+
+    def _capture(self):
+        G, P = self.cluster.n_groups, self.cluster.n_peers
+        from ..raft import StateRole
+
+        state = np.zeros((G, P), dtype=np.int64)
+        term = np.zeros((G, P), dtype=np.int64)
+        commit = np.zeros((G, P), dtype=np.int64)
+        for g in range(G):
+            for p in range(P):
+                r = self.cluster.networks[g].peers[p + 1].raft
+                state[g, p] = int(r.state)
+                term[g, p] = r.term
+                commit[g, p] = r.raft_log.committed
+        return state, term, commit, int(StateRole.Leader)
+
+    def round(self, crashed=None, append_n=None) -> None:
+        """Drive one cluster round and fold its health facts into the
+        planes (the scalar twin of sim.step's health extra)."""
+        G, P = self.cluster.n_groups, self.cluster.n_peers
+        if crashed is None:
+            crashed = np.zeros((G, P), dtype=bool)
+        pre_state, pre_term, pre_commit, leader_code = self._capture()
+        want_campaign = np.zeros((G, P), dtype=bool)
+        for g in range(G):
+            for p in range(P):
+                r = self.cluster.networks[g].peers[p + 1].raft
+                want_campaign[g, p] = (
+                    int(r.state) != leader_code
+                    and r.promotable
+                    and r.election_elapsed + 1 >= r.randomized_election_timeout
+                )
+
+        self.cluster.round(crashed, append_n)
+
+        post_state, post_term, post_commit, _ = self._capture()
+        alive = ~np.asarray(crashed, dtype=bool)
+        has_leader = np.any((post_state == leader_code) & alive, axis=1)
+        commit_adv = post_commit.max(axis=1) > pre_commit.max(axis=1)
+        term_bump = (post_term.max(axis=1) - pre_term.max(axis=1)).astype(
+            np.int32
+        )
+        won = np.any(
+            (post_state == leader_code)
+            & ((pre_state != leader_code) | (post_term > pre_term)),
+            axis=1,
+        )
+        campaigned = np.any(want_campaign, axis=1)
+
+        leaderless, since, bumps, splits = self.planes
+        leaderless = np.where(has_leader, 0, leaderless + 1)
+        since = np.where(commit_adv, 0, since + 1)
+        if self.window_pos == 0:
+            bumps = np.zeros_like(bumps)
+        bumps = bumps + term_bump
+        splits = splits + (campaigned & ~won).astype(np.int32)
+        self.planes = np.stack([leaderless, since, bumps, splits]).astype(
+            np.int32
+        )
+        self.window_pos = (self.window_pos + 1) % self.window

@@ -26,15 +26,30 @@ from typing import Dict, List
 import jax
 
 
+def start_trace(log_dir: str, host_profiler: bool = False) -> None:
+    """Begin a JAX profiler (XLA) trace writing into `log_dir`.
+
+    The imperative twin of `device_trace` for callers whose start/stop
+    points do not nest lexically (bench.py --profile brackets its timed
+    region across loop iterations this way).  Must be paired with
+    `stop_trace`; traces do not nest."""
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=host_profiler)
+
+
+def stop_trace() -> None:
+    """End the trace started by `start_trace` and flush it to disk."""
+    jax.profiler.stop_trace()
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: str, host_profiler: bool = False):
     """Capture a JAX profiler trace of everything inside the block; view
     with TensorBoard's profile plugin or ui.perfetto.dev."""
-    jax.profiler.start_trace(log_dir, create_perfetto_trace=host_profiler)
+    start_trace(log_dir, host_profiler=host_profiler)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_trace()
 
 
 def annotate(name: str):

@@ -450,3 +450,58 @@ def test_syntax_error_reports_parse_error_not_crash(tmp_path):
     vs = run_on(tmp_path, "raft_tpu/broken.py", "def f(:\n")
     assert ids(vs) == ["GC000"]
     assert vs[0].slug == "parse-error"
+
+
+# --- PR 3 rule-list extensions: health-plane code paths are in scope ---
+
+
+def test_gc002_covers_health_module(tmp_path):
+    # The HealthMonitor sits on the drain boundary: a device sync creeping
+    # into its record path must trip GC002 like any kernel module.
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/health.py",
+        """\
+        import jax
+
+        class HealthMonitor:
+            def record(self, summary):
+                return jax.device_get(summary)
+        """,
+    )
+    assert ids(vs) == ["GC002"]
+
+
+def test_gc004_covers_health_module(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/health.py",
+        """\
+        class HealthMonitor:
+            def record(self, summary):
+                self.metrics.on_health_summary(summary)
+
+            def record_guarded(self, summary):
+                m = self.metrics
+                if m is not None:
+                    m.on_health_summary(summary)
+        """,
+    )
+    assert ids(vs) == ["GC004"]
+
+
+def test_gc003_accepts_health_config_fields(tmp_path):
+    # The new SimConfig health fields are compile-time static.
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/multiraft/sim.py",
+        """\
+        def step(cfg, st):
+            if cfg.collect_health:
+                w = cfg.health_window
+            if cfg.churn_bumps > cfg.health_topk:
+                pass
+            return st
+        """,
+    )
+    assert ids(vs) == []

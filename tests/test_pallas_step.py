@@ -217,3 +217,72 @@ def test_hybrid_storm_overflow_falls_back():
                 np.asarray(getattr(b, f)),
                 err_msg=f"block {blk} field {f}",
             )
+
+
+@pytest.mark.slow  # ~8s of interpret-mode compile: the tier-1 gate is full
+def test_steady_round_health_matches_general_steps():
+    """The fused health fold (in-kernel ticks_since_commit + closed-form
+    window math) must be bit-identical to threading sim.step's health
+    extra through the same k rounds — including a window boundary inside
+    the horizon and junk pre-state in every plane."""
+    cfg = SimConfig(n_groups=8, n_peers=3, collect_health=True, health_window=8)
+    k = 2
+    st = settle(cfg)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed, horizon=k))
+
+    h0 = sim.init_health(cfg)
+    # Junk pre-state: term bumps + splits survive or reset per the rules.
+    h0 = h0._replace(
+        planes=h0.planes.at[2].set(3).at[3].set(5),
+        window_pos=jnp.int32(7),  # boundary inside the 2-round horizon
+    )
+    want_st, want_h = st, h0
+    for _ in range(k):
+        want_st, want_h = sim.step(cfg, want_st, crashed, append, health=want_h)
+
+    fused = pallas_step.steady_round(cfg, rounds=k, with_health=True)
+    got_st, got_h = fused(st, crashed, append, h0)
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want_st, f)),
+            np.asarray(getattr(got_st, f)),
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(want_h.planes), np.asarray(got_h.planes)
+    )
+    assert int(want_h.window_pos) == int(got_h.window_pos)
+
+
+@pytest.mark.slow  # compiles the full cond(fused, scan-of-general) graph
+def test_fast_multi_round_health_both_branches():
+    """fast_multi_round(with_health=True): the fused branch (steady start)
+    and the general branch (boot storm) both thread the planes exactly."""
+    cfg = SimConfig(n_groups=8, n_peers=3, collect_health=True, health_window=8)
+    k = 4
+    fast = pallas_step.fast_multi_round(cfg, k=k, with_health=True)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+
+    for start in ("steady", "boot"):
+        st = settle(cfg) if start == "steady" else sim.init_state(cfg)
+        h = sim.init_health(cfg)
+        want_st, want_h = st, h
+        for _ in range(k):
+            want_st, want_h = sim.step(
+                cfg, want_st, crashed, append, health=want_h
+            )
+        got_st, got_h = fast(st, crashed, append, h)
+        np.testing.assert_array_equal(
+            np.asarray(want_h.planes),
+            np.asarray(got_h.planes),
+            err_msg=start,
+        )
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want_st, f)),
+                np.asarray(getattr(got_st, f)),
+                err_msg=f"{start} field {f}",
+            )

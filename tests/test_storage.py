@@ -152,3 +152,99 @@ def test_initial_state():
         core.set_hardstate(HardState(term=2, vote=1, commit=0))
     st = s.initial_state()
     assert st.hard_state.term == 2
+
+
+# --- ArrayStorage: the dense SoA twin must behave exactly like MemStorage
+# through the public surface (the VERDICT "Missing #4" satellite) ---
+
+
+def _drive(store):
+    """One op sequence covering append/conflict/compact/snapshot/commit;
+    returns every observable result for cross-implementation comparison."""
+    from raft_tpu.eraftpb import EntryType
+
+    out = []
+    with store.wl() as core:
+        core.append(
+            [
+                Entry(index=1, term=1, data=b"a"),
+                Entry(index=2, term=2, data=b"b", context=b"ctx"),
+                Entry(
+                    index=3,
+                    term=2,
+                    entry_type=EntryType.EntryConfChange,
+                    data=b"cc",
+                ),
+            ]
+        )
+    out.append((store.first_index(), store.last_index()))
+    out.append([store.term(i) for i in range(1, 4)])
+    out.append(store.entries(1, 4))
+    # conflicting suffix overwrite
+    with store.wl() as core:
+        core.append([Entry(index=2, term=3, data=b"B"), Entry(index=3, term=3)])
+    out.append(store.entries(1, 4))
+    # byte-capped read never returns empty if an entry is in range
+    out.append(store.entries(1, 4, max_size=0))
+    with store.wl() as core:
+        core.commit_to(3)
+        out.append((core.hard_state().commit, core.hard_state().term))
+        core.compact(2)
+    out.append((store.first_index(), store.last_index()))
+    with pytest.raises(Compacted):
+        store.term(1)
+    with pytest.raises(Compacted):
+        store.entries(1, 3)
+    with pytest.raises(Unavailable):
+        store.term(9)
+    with store.wl() as core:
+        snap = c_snap = core.make_snapshot()
+    out.append((snap.metadata.index, snap.metadata.term))
+    with store.wl() as core:
+        core.apply_snapshot(c_snap)
+    out.append((store.first_index(), store.last_index()))
+    with pytest.raises(SnapshotOutOfDate):
+        with store.wl() as core:
+            stale = Snapshot()
+            stale.metadata.index = 1
+            core.apply_snapshot(stale)
+    # post-snapshot appends continue from the snapshot index
+    with store.wl() as core:
+        core.append([Entry(index=4, term=4, data=b"z")])
+    out.append((store.first_index(), store.last_index(), store.term(4)))
+    return out
+
+
+def test_array_storage_matches_mem_storage():
+    from raft_tpu.storage import ArrayStorage
+
+    a = _drive(ArrayStorage.new_with_conf_state(([1, 2, 3], [])))
+    m = _drive(MemStorage.new_with_conf_state(([1, 2, 3], [])))
+    assert a == m  # Entry is a dataclass: deep value comparison
+
+
+def test_array_storage_capacity_doubles():
+    from raft_tpu.storage import ArrayStorage
+
+    s = ArrayStorage.new_with_conf_state(([1], []))
+    with s.wl() as core:
+        core.append([Entry(index=i, term=1) for i in range(1, 101)])
+    assert s.last_index() == 100
+    assert s.term(100) == 1
+    assert len(s.entries(50, 101)) == 51
+
+
+def test_array_storage_initial_and_hard_state():
+    from raft_tpu.storage import ArrayStorage
+
+    s = ArrayStorage.new_with_conf_state(([1, 2], [3]))
+    st = s.initial_state()
+    assert st.initialized()
+    assert st.conf_state.voters == [1, 2]
+    with s.wl() as core:
+        core.set_hardstate(HardState(term=5, vote=2, commit=0))
+    assert s.initial_state().hard_state.term == 5
+    with s.wl() as core:
+        core.trigger_snap_unavailable_once()
+    with pytest.raises(SnapshotTemporarilyUnavailable):
+        s.snapshot(0)

@@ -12,6 +12,11 @@ host-side drains carry an allow marker with a justification.
 module-level (traced) functions: on a traced value they raise
 ConcretizationTypeError at best and force a device sync at worst, while
 the class-body host wrappers use them legitimately on downloaded values.
+
+health.py (the HealthMonitor) is in scope even though it holds no jitted
+code: it sits on the drain boundary and must only ever receive host dicts
+— a device_get creeping into its record path would silently sync every
+summary.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ _KERNEL_MODULES = (
     "raft_tpu/multiraft/sim.py",
     "raft_tpu/multiraft/kernels.py",
     "raft_tpu/multiraft/pallas_step.py",
+    "raft_tpu/multiraft/health.py",
 )
 
 _NUMPY_ALIASES = {"np", "numpy", "onp", "_np"}

@@ -42,6 +42,12 @@ _STATIC_CONFIG_FIELDS = {
     "election_tick",
     "heartbeat_tick",
     "collect_counters",
+    "collect_health",
+    "health_window",
+    "leaderless_stall_ticks",
+    "commit_stall_ticks",
+    "churn_bumps",
+    "health_topk",
     "min_timeout",
     "max_timeout",
 }

@@ -40,6 +40,7 @@ _METRICS_MODULES = (
     "raft_tpu/raft.py",
     "raft_tpu/raw_node.py",
     "raft_tpu/multiraft/driver.py",
+    "raft_tpu/multiraft/health.py",
 )
 
 
