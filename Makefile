@@ -9,7 +9,7 @@ PY ?= python
 CHECK_PATHS = raft_tpu tests bench.py benches docs README.md CHANGES.md
 
 .PHONY: all test test-fast bench bench-suites native examples clean \
-	lint typecheck check
+	lint typecheck check obligations
 
 all: native test
 
@@ -22,12 +22,20 @@ test:
 	$(PY) -m pytest tests/ -q
 
 # Static analysis (docs/STATIC_ANALYSIS.md): graftcheck always runs (it is
-# zero-dependency); ruff runs when installed (CI installs it).
+# zero-dependency; --engine adds the cross-module abstract-interpretation
+# rules GC007-GC010, and the mtime run cache keeps an unchanged tree under
+# ~2s); ruff runs when installed (CI installs it).
 lint:
-	$(PY) -m tools.graftcheck $(CHECK_PATHS)
+	$(PY) -m tools.graftcheck --engine $(CHECK_PATHS)
 	@if $(PY) -c "import ruff" 2>/dev/null || command -v ruff >/dev/null; \
 	then ruff check .; \
 	else echo "ruff not installed; skipped (CI runs it)"; fi
+
+# Regenerate the GC010 parity-obligations baseline after an intentional
+# kernel/oracle change; CI diffs the extraction against this committed file.
+obligations:
+	$(PY) -m tools.graftcheck --emit-obligations \
+		tools/graftcheck/parity_obligations.json raft_tpu/multiraft tests
 
 # mypy is a dev-only dependency; the target fails loudly if it's missing so
 # a silent skip can never masquerade as a green typecheck.

@@ -100,6 +100,9 @@ class MultiRaft:
         # Fleet-health planes (numpy, this node's view of each group).
         # vote splits are not observable from one peer — that plane lives
         # on the device sim only (docs/OBSERVABILITY.md "Fleet health").
+        # Deliberately int64: these are HOST accumulators outside the
+        # GC007/GC008 int32 device-plane contract, so they never wrap and
+        # need no drain cadence (docs/STATIC_ANALYSIS.md, GC008 table).
         self.health_config = health
         self.health_monitor: Optional[HealthMonitor] = None
         if health is not None:

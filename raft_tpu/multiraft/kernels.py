@@ -59,13 +59,14 @@ VOTE_LOST = 1
 VOTE_WON = 2
 
 
-def majority_of(count: jnp.ndarray) -> jnp.ndarray:
+def majority_of(count: jnp.ndarray) -> jnp.ndarray:  # gc: int32[...]
     """Quorum size: n // 2 + 1 (reference: util.rs:118-120)."""
     return count // 2 + 1
 
 
 def committed_index(
-    matched: jnp.ndarray, voter_mask: jnp.ndarray
+    matched: jnp.ndarray,  # gc: int32[..., P]
+    voter_mask: jnp.ndarray,  # gc: bool[..., P]
 ) -> jnp.ndarray:
     """Per-group quorum commit index over the peer axis.
 
@@ -92,7 +93,9 @@ def committed_index(
 
 
 def committed_index_grouped(
-    matched: jnp.ndarray, group_ids: jnp.ndarray, voter_mask: jnp.ndarray
+    matched: jnp.ndarray,  # gc: int32[..., P]
+    group_ids: jnp.ndarray,  # gc: int32[..., P]
+    voter_mask: jnp.ndarray,  # gc: bool[..., P]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Group-commit variant (reference: majority.rs:99-124): commits need
     acks from >= 2 distinct commit groups.
@@ -168,9 +171,9 @@ def committed_index_grouped(
 
 
 def joint_committed_index(
-    matched: jnp.ndarray,
-    incoming_mask: jnp.ndarray,
-    outgoing_mask: jnp.ndarray,
+    matched: jnp.ndarray,  # gc: int32[..., P]
+    incoming_mask: jnp.ndarray,  # gc: bool[..., P]
+    outgoing_mask: jnp.ndarray,  # gc: bool[..., P]
 ) -> jnp.ndarray:
     """Joint config: min over both majorities (reference: joint.rs:47-51).
     An empty outgoing half returns INF from committed_index, so min()
@@ -182,7 +185,9 @@ def joint_committed_index(
 
 
 def vote_result(
-    granted: jnp.ndarray, rejected: jnp.ndarray, voter_mask: jnp.ndarray
+    granted: jnp.ndarray,  # gc: bool[..., P]
+    rejected: jnp.ndarray,  # gc: bool[..., P]
+    voter_mask: jnp.ndarray,  # gc: bool[..., P]
 ) -> jnp.ndarray:
     """Vote outcome over the peer axis (reference: majority.rs:130-154).
 
@@ -202,10 +207,10 @@ def vote_result(
 
 
 def joint_vote_result(
-    granted: jnp.ndarray,
-    rejected: jnp.ndarray,
-    incoming_mask: jnp.ndarray,
-    outgoing_mask: jnp.ndarray,
+    granted: jnp.ndarray,  # gc: bool[..., P]
+    rejected: jnp.ndarray,  # gc: bool[..., P]
+    incoming_mask: jnp.ndarray,  # gc: bool[..., P]
+    outgoing_mask: jnp.ndarray,  # gc: bool[..., P]
 ) -> jnp.ndarray:
     """reference: joint.rs:56-67"""
     i = vote_result(granted, rejected, incoming_mask)
@@ -216,7 +221,10 @@ def joint_vote_result(
 
 
 def timeout_draw(
-    node_key: jnp.ndarray, epoch: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray
+    node_key: jnp.ndarray,  # gc: uint32[...]
+    epoch: jnp.ndarray,  # gc: uint32[...]
+    lo: jnp.ndarray,  # gc: int32[...]
+    hi: jnp.ndarray,  # gc: int32[...]
 ) -> jnp.ndarray:
     """Randomized election timeout in [lo, hi) — the device side of
     util.deterministic_timeout (identical 32-bit murmur3-finalizer mix)."""
@@ -268,23 +276,26 @@ def zero_counters() -> jnp.ndarray:
 
 
 def count_events(
-    counters: jnp.ndarray,
-    want_campaign: jnp.ndarray,
-    want_heartbeat: jnp.ndarray,
-    won: jnp.ndarray,
-    commit_delta: jnp.ndarray,
+    counters: jnp.ndarray,  # gc: int32[N]
+    want_campaign: jnp.ndarray,  # gc: bool[...]
+    want_heartbeat: jnp.ndarray,  # gc: bool[...]
+    won: jnp.ndarray,  # gc: bool[...]
+    commit_delta: jnp.ndarray,  # gc: int32[...]
 ) -> jnp.ndarray:
     """Fold one round's event masks into the accumulator plane.
 
     want_campaign/want_heartbeat/won: bool planes (any shape); commit_delta:
     int32 plane of per-peer commit-index increases this round.
     """
+    # dtype= on every sum: a bare jnp.sum of bool/int32 widens to int64
+    # under x64 (only there — the non-x64 suite truncates it back), which
+    # would silently change the accumulator plane's dtype (GC007).
     events = jnp.stack(
         [
-            jnp.sum(want_campaign.astype(jnp.int32)),
-            jnp.sum(want_heartbeat.astype(jnp.int32)),
-            jnp.sum(won.astype(jnp.int32)),
-            jnp.sum(commit_delta),
+            jnp.sum(want_campaign, dtype=jnp.int32),
+            jnp.sum(want_heartbeat, dtype=jnp.int32),
+            jnp.sum(won, dtype=jnp.int32),
+            jnp.sum(commit_delta, dtype=jnp.int32),
         ]
     ).astype(counters.dtype)
     return counters + events
@@ -340,13 +351,13 @@ def zero_health(n_groups: int) -> jnp.ndarray:
 
 
 def update_health(
-    planes: jnp.ndarray,
-    window_pos: jnp.ndarray,
+    planes: jnp.ndarray,  # gc: int32[H, G]
+    window_pos: jnp.ndarray,  # gc: int32[]
     window: int,
-    has_leader: jnp.ndarray,
-    commit_advanced: jnp.ndarray,
-    term_bump: jnp.ndarray,
-    vote_split: jnp.ndarray,
+    has_leader: jnp.ndarray,  # gc: bool[G]
+    commit_advanced: jnp.ndarray,  # gc: bool[G]
+    term_bump: jnp.ndarray,  # gc: int32[G]
+    vote_split: jnp.ndarray,  # gc: bool[G]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fold one protocol round into the health planes.
 
@@ -372,7 +383,7 @@ def update_health(
 
 
 def health_summary(
-    planes: jnp.ndarray,
+    planes: jnp.ndarray,  # gc: int32[H, G]
     stall_ticks: int,
     commit_stall_ticks: int,
     churn_bumps: int,
@@ -392,16 +403,18 @@ def health_summary(
     leaderless = planes[HP_LEADERLESS]
     lag = planes[HP_SINCE_COMMIT]
     bumps = planes[HP_TERM_BUMPS]
+    # dtype= keeps the summary int32 under x64 too (a bare bool sum widens
+    # to int64 there, changing the host-boundary buffer dtype — GC007).
     counts = jnp.stack(
         [
-            jnp.sum((leaderless > 0).astype(jnp.int32)),
-            jnp.sum((leaderless >= stall_ticks).astype(jnp.int32)),
-            jnp.sum((lag >= commit_stall_ticks).astype(jnp.int32)),
-            jnp.sum((bumps >= churn_bumps).astype(jnp.int32)),
+            jnp.sum(leaderless > 0, dtype=jnp.int32),
+            jnp.sum(leaderless >= stall_ticks, dtype=jnp.int32),
+            jnp.sum(lag >= commit_stall_ticks, dtype=jnp.int32),
+            jnp.sum(bumps >= churn_bumps, dtype=jnp.int32),
         ]
     )
     bounds = jnp.asarray(LAG_BUCKET_BOUNDS, jnp.int32)
-    bucket = jnp.sum((lag[:, None] >= bounds[None, :]).astype(jnp.int32), axis=1)
+    bucket = jnp.sum(lag[:, None] >= bounds[None, :], axis=1, dtype=jnp.int32)
     hist = jnp.zeros((N_LAG_BUCKETS,), jnp.int32).at[bucket].add(1)
     score = jnp.maximum(lag, leaderless)
     worst_scores, worst_ids = jax.lax.top_k(score, k)
@@ -414,11 +427,11 @@ def health_summary(
 
 
 def tick_kernel(
-    state: jnp.ndarray,
-    election_elapsed: jnp.ndarray,
-    heartbeat_elapsed: jnp.ndarray,
-    randomized_timeout: jnp.ndarray,
-    promotable: jnp.ndarray,
+    state: jnp.ndarray,  # gc: int32[...]
+    election_elapsed: jnp.ndarray,  # gc: int32[...]
+    heartbeat_elapsed: jnp.ndarray,  # gc: int32[...]
+    randomized_timeout: jnp.ndarray,  # gc: int32[...]
+    promotable: jnp.ndarray,  # gc: bool[...]
     election_timeout: int,
     heartbeat_timeout: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -459,10 +472,10 @@ def tick_kernel(
 
 
 def append_response_update(
-    matched: jnp.ndarray,
-    next_idx: jnp.ndarray,
-    resp_index: jnp.ndarray,
-    resp_mask: jnp.ndarray,
+    matched: jnp.ndarray,  # gc: int32[...]
+    next_idx: jnp.ndarray,  # gc: int32[...]
+    resp_index: jnp.ndarray,  # gc: int32[...]
+    resp_mask: jnp.ndarray,  # gc: bool[...]
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Batched Progress.maybe_update for accepted append responses
     (reference: progress.rs:138-150): matched = max(matched, index),

@@ -93,7 +93,10 @@ def _steady_kernel(
     role_leader = state == ROLE_LEADER  # [P, B]
     is_leader = role_leader & alive
     has_leader = jnp.any(is_leader, axis=0, keepdims=True)  # [1, B]
-    count = jnp.sum(voter.astype(jnp.int32), axis=0, keepdims=True)
+    # dtype= on every sum in the kernel: a bare jnp.sum widens to int64
+    # under x64 — inside a Mosaic kernel that is not even lowerable, and in
+    # interpret mode it silently changes the tile dtypes (GC007).
+    count = jnp.sum(voter, axis=0, keepdims=True, dtype=jnp.int32)
     qpos = count // 2
     n_app = jnp.where(has_leader, app, 0)  # [1, B]
 
@@ -108,8 +111,14 @@ def _steady_kernel(
         # --- appends at the (unique alive) leader ---
         li = li + jnp.where(is_leader, n_app, 0)
         lt = jnp.where(is_leader, term, lt)
-        lead_last = jnp.sum(jnp.where(is_leader, li, 0), axis=0, keepdims=True)
-        lead_lt = jnp.sum(jnp.where(is_leader, lt, 0), axis=0, keepdims=True)
+        lead_last = jnp.sum(
+            jnp.where(is_leader, li, 0), axis=0, keepdims=True,
+            dtype=jnp.int32,
+        )
+        lead_lt = jnp.sum(
+            jnp.where(is_leader, lt, 0), axis=0, keepdims=True,
+            dtype=jnp.int32,
+        )
 
         lead_beat = jnp.any(want_beat & is_leader, axis=0, keepdims=True)
         sent = has_leader & (lead_beat | (n_app > 0))  # [1, B]
@@ -139,7 +148,8 @@ def _steady_kernel(
 
         ok = has_leader & sent & (mci >= term_start)
         lead_commit_old = jnp.sum(
-            jnp.where(is_leader, commit, 0), axis=0, keepdims=True
+            jnp.where(is_leader, commit, 0), axis=0, keepdims=True,
+            dtype=jnp.int32,
         )
         lead_commit = jnp.where(
             ok, jnp.maximum(lead_commit_old, mci), lead_commit_old
@@ -235,8 +245,14 @@ def steady_round(
         # once outside the kernel and scattered back after.
         is_leader = (st.state == ROLE_LEADER) & ~crashed
         f = is_leader.astype(jnp.int32)
-        acting_row = jnp.sum(st.matched * f[:, None, :], axis=0)  # [P, G]
-        ts_acting = jnp.sum(st.term_start_index * f, axis=0)  # [G]
+        # dtype= keeps the gathered tracker rows int32 under x64: these
+        # feed pallas_call inputs whose BlockSpecs assume int32 (GC007).
+        acting_row = jnp.sum(
+            st.matched * f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, G]
+        ts_acting = jnp.sum(
+            st.term_start_index * f, axis=0, dtype=jnp.int32
+        )  # [G]
 
         inputs = (
             st.state,
@@ -267,7 +283,9 @@ def steady_round(
         member = st.voter_mask | st.learner_mask
         in_s = (member & ~crashed) | is_leader
         lead_last = jnp.max(jnp.where(is_leader, li, 0), axis=0)  # [G]
-        lead_row = jnp.sum(st.agree * f[:, None, :], axis=0)  # [P, G]
+        lead_row = jnp.sum(
+            st.agree * f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, G]
         agree = jnp.where(
             in_s[:, None, :] & in_s[None, :, :],
             lead_last[None, None, :],

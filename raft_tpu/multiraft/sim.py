@@ -101,23 +101,23 @@ class SimState(NamedTuple):
     """Device-resident SoA state, peer-major [P, G] int32/bool (SURVEY.md §7
     phase-4 state inventory)."""
 
-    term: jnp.ndarray
-    state: jnp.ndarray  # ROLE_* codes
-    vote: jnp.ndarray  # 0 = none, else peer id (1..P)
-    leader_id: jnp.ndarray  # each peer's view; 0 = none
-    election_elapsed: jnp.ndarray
-    heartbeat_elapsed: jnp.ndarray
-    randomized_timeout: jnp.ndarray
-    last_index: jnp.ndarray
-    last_term: jnp.ndarray
-    commit: jnp.ndarray
+    term: jnp.ndarray  # gc: int32[P, G]
+    state: jnp.ndarray  # gc: int32[P, G] — ROLE_* codes
+    vote: jnp.ndarray  # gc: int32[P, G] — 0 = none, else peer id (1..P)
+    leader_id: jnp.ndarray  # gc: int32[P, G] — each peer's view; 0 = none
+    election_elapsed: jnp.ndarray  # gc: int32[P, G]
+    heartbeat_elapsed: jnp.ndarray  # gc: int32[P, G]
+    randomized_timeout: jnp.ndarray  # gc: int32[P, G]
+    last_index: jnp.ndarray  # gc: int32[P, G]
+    last_term: jnp.ndarray  # gc: int32[P, G]
+    commit: jnp.ndarray  # gc: int32[P, G]
     # Per-OWNER leader bookkeeping.  Every peer that has ever led keeps its
     # own frozen ProgressTracker row, exactly like the scalar per-peer
     # tracker (reference: tracker.rs): when the current leader crashes and a
     # stale alive leader keeps acting, it must use ITS view of matched /
     # term-start, not the newer regime's (found by the storm parity test).
-    matched: jnp.ndarray  # [P_owner, P_target, G] Progress.matched views
-    term_start_index: jnp.ndarray  # [P, G] owner's noop index
+    matched: jnp.ndarray  # gc: int32[P, P, G] — per-OWNER Progress.matched
+    term_start_index: jnp.ndarray  # gc: int32[P, G] — owner's noop index
     # Pairwise log-agreement lengths: agree[a, b, g] = length of the common
     # prefix of peer a's and b's logs.  Logs CAN diverge (a crashed peer
     # keeps a stale uncommitted suffix while a new regime canonizes other
@@ -127,16 +127,16 @@ class SimState(NamedTuple):
     # m.commit_term" check computable from cursors: the sender committed
     # m.commit, so the receiver's entry there matches iff
     # m.commit <= agree[receiver, sender] (index+term identify entries).
-    agree: jnp.ndarray  # [P, P, G]
-    voter_mask: jnp.ndarray  # [P, G] incoming majority config
+    agree: jnp.ndarray  # gc: int32[P, P, G]
+    voter_mask: jnp.ndarray  # gc: bool[P, G] — incoming majority config
     # Outgoing majority for joint consensus (reference: joint.rs:12-15):
     # all-False = not joint; decisions then need BOTH majorities (BASELINE
     # config 4's quorum path).  Conf changes are host-side barriers that
     # swap these mask planes (SURVEY.md §7 hard-part 5).
-    outgoing_mask: jnp.ndarray  # [P, G]
+    outgoing_mask: jnp.ndarray  # gc: bool[P, G]
     # Learners (reference: tracker.rs:40-49): replicated to, never voting,
     # never campaigning, never counted in quorums.
-    learner_mask: jnp.ndarray  # [P, G]
+    learner_mask: jnp.ndarray  # gc: bool[P, G]
 
 
 class HealthState(NamedTuple):
@@ -150,8 +150,8 @@ class HealthState(NamedTuple):
                 term-bump plane resets when it wraps to 0.
     """
 
-    planes: jnp.ndarray
-    window_pos: jnp.ndarray
+    planes: jnp.ndarray  # gc: int32[H, G]
+    window_pos: jnp.ndarray  # gc: int32[]
 
 
 def init_health(cfg: SimConfig) -> HealthState:
@@ -257,11 +257,11 @@ def _quorum_index(matched: jnp.ndarray, voter_mask: jnp.ndarray) -> jnp.ndarray:
 def step(
     cfg: SimConfig,
     st: SimState,
-    crashed: jnp.ndarray,
-    append_n: jnp.ndarray,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    append_n: jnp.ndarray,  # gc: int32[G]
     group_ids: Optional[jnp.ndarray] = None,
-    counters: Optional[jnp.ndarray] = None,
-    health: Optional[HealthState] = None,
+    counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
+    health: Optional[HealthState] = None,  # gc: HealthState
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -354,7 +354,11 @@ def step(
         first_req = jnp.min(jnp.where(req, p_idx, P), axis=0)
         hb_first = prev_first < first_req
         prev_f = prev_is_acting.astype(jnp.int32)
-        prev_row = jnp.sum(matched * prev_f[:, None, :], axis=0)  # [P, G]
+        # dtype= on the masked-row sums: bare jnp.sum widens int32 to int64
+        # under x64, silently turning the state planes int64 (GC007).
+        prev_row = jnp.sum(
+            matched * prev_f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, G]
         prev_commit = jnp.max(jnp.where(prev_is_acting, commit, 0), axis=0)
         hb_val = jnp.minimum(prev_row, prev_commit[None, :])
         apply_v = (
@@ -661,7 +665,7 @@ def step(
     acting_f = is_acting_leader.astype(jnp.int32)  # [P, G]
     in_s = sync | is_acting_leader  # [P, G]
     agree_lead_row = jnp.sum(
-        st.agree * acting_f[:, None, :], axis=0
+        st.agree * acting_f[:, None, :], axis=0, dtype=jnp.int32
     )  # [P, G]: agree[l, b]
     agree = jnp.where(
         in_s[:, None, :] & in_s[None, :, :],
@@ -672,12 +676,14 @@ def step(
             jnp.where(in_s[None, :, :], agree_lead_row[:, None, :], st.agree),
         ),
     )
-    acting_row = jnp.sum(matched * acting_f[:, None, :], axis=0)  # [P_t, G]
+    acting_row = jnp.sum(
+        matched * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )  # [P_t, G]
     acting_row = jnp.where(sync | is_acting_leader, new_last_index, acting_row)
     matched = jnp.where(
         is_acting_leader[:, None, :], acting_row[None, :, :], matched
     )
-    ts_acting = jnp.sum(term_start * acting_f, axis=0)  # [G]
+    ts_acting = jnp.sum(term_start * acting_f, axis=0, dtype=jnp.int32)  # [G]
 
     # Quorum commit: jointly committed = min over both majorities
     # (reference: joint.rs:47-51; an empty outgoing half returns INF so the
@@ -756,7 +762,9 @@ def step(
 
 
 def read_index(
-    cfg: SimConfig, st: SimState, crashed: jnp.ndarray
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
 ) -> jnp.ndarray:
     """Batched linearizable ReadIndex barrier, Safe mode (reference:
     read_only.rs:65-140 + raft.rs step_leader MsgReadIndex 2067-2096 +
@@ -785,8 +793,13 @@ def read_index(
     lead_term = jnp.max(jnp.where(is_lead, st.term, -1), axis=0)  # [G]
     acting = is_lead & (st.term == lead_term[None, :])  # [P, G], unique
     has_lead = jnp.any(acting, axis=0)
-    lead_commit = jnp.sum(jnp.where(acting, st.commit, 0), axis=0)
-    lead_ts = jnp.sum(jnp.where(acting, st.term_start_index, 0), axis=0)
+    # dtype= so the probed indices stay int32 under x64 (GC007).
+    lead_commit = jnp.sum(
+        jnp.where(acting, st.commit, 0), axis=0, dtype=jnp.int32
+    )
+    lead_ts = jnp.sum(
+        jnp.where(acting, st.term_start_index, 0), axis=0, dtype=jnp.int32
+    )
     servable = has_lead & (lead_commit >= lead_ts)
 
     n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)

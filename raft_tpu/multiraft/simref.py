@@ -165,6 +165,11 @@ class HealthOracle:
 
     tests/test_health_parity.py asserts exact per-round equality of these
     planes against ClusterSim's device-maintained HealthState.
+
+    This class is the resolved GC010 oracle symbol for the health kernels
+    (tools/graftcheck/parity_obligations.json: zero_health/update_health
+    -> simref.HealthOracle); renaming it or its `round` entry point is an
+    obligation change and must go through `make obligations`.
     """
 
     def __init__(self, cluster: ScalarCluster, window: int = 32):

@@ -505,3 +505,570 @@ def test_gc003_accepts_health_config_fields(tmp_path):
         """,
     )
     assert ids(vs) == []
+
+
+# --- PR 4 engine rules (GC007-GC010): cross-module abstract interpretation
+
+
+from tools.graftcheck.engine import run_engine  # noqa: E402
+
+
+def run_engine_on(tmp_path, files, with_suite_stub=True):
+    """Write a repo-shaped fixture tree and run the engine over it.
+
+    `files` maps repo-relative paths to (dedented) sources.  A stub
+    tests/test_sim_parity.py is created by default so GC010's
+    suite-must-exist check doesn't fire on fixtures about OTHER rules."""
+    if with_suite_stub and "tests/test_sim_parity.py" not in files:
+        files = dict(files)
+        files["tests/test_sim_parity.py"] = "# parity suite stub\n"
+    for rel, src in files.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src))
+    tests_root = tmp_path / "tests"
+    ctx = Context(
+        repo_root=tmp_path,
+        tests_root=tests_root if tests_root.is_dir() else None,
+        reference_root=None,
+    )
+    return run_engine([str(tmp_path / "raft_tpu")], ctx)
+
+
+# --- GC007 shape-dtype ---
+
+
+def test_gc007_bare_reduction_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """m <-> o"""
+            import jax.numpy as jnp
+
+            def m(x):  # gc: int32[P, G]
+                return jnp.sum(x, axis=0)
+            ''',
+        },
+    )
+    gc7 = [v for v in vs if v.rule_id == "GC007"]
+    assert len(gc7) == 1
+    assert "dtype=jnp.int32" in gc7[0].message
+
+
+def test_gc007_reduction_with_dtype_or_astype_passes(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """a <-> o; b <-> o; c <-> o"""
+            import jax.numpy as jnp
+
+            def a(x):  # gc: int32[P, G]
+                return jnp.sum(x, axis=0, dtype=jnp.int32)
+
+            def b(x):  # gc: bool[P, G]
+                return jnp.sum(x, axis=0).astype(jnp.int32)
+
+            def c(x):  # gc: int32[P, G]
+                return jnp.sum(x, axis=0) == 1
+            ''',
+        },
+    )
+    assert [v.rule_id for v in vs if v.rule_id == "GC007"] == []
+
+
+def test_gc007_signed_unsigned_mix_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """m <-> o"""
+            import jax.numpy as jnp
+
+            def m(
+                x,  # gc: int32[G]
+                y,  # gc: uint32[G]
+            ):
+                return x + y
+            ''',
+        },
+    )
+    gc7 = [v for v in vs if v.rule_id == "GC007"]
+    assert len(gc7) == 1 and "int64" in gc7[0].message
+
+
+def test_gc007_bool_scalar_arithmetic_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """m <-> o"""
+            import jax.numpy as jnp
+
+            def m(x):  # gc: bool[G]
+                return x + 1
+            ''',
+        },
+    )
+    gc7 = [v for v in vs if v.rule_id == "GC007"]
+    assert len(gc7) == 1 and "bool array" in gc7[0].message
+
+
+def test_gc007_call_boundary_dtype_and_rank(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """helper <-> o; bad_dtype <-> o; bad_rank <-> o; ok <-> o"""
+            import jax.numpy as jnp
+
+            def helper(x):  # gc: int32[G]
+                return x
+
+            def bad_dtype(y):  # gc: uint32[G]
+                return helper(y)
+
+            def bad_rank(y):  # gc: int32[P, G]
+                return helper(y)
+
+            def ok(y):  # gc: int32[G]
+                return helper(y)
+            ''',
+        },
+    )
+    gc7 = [v for v in vs if v.rule_id == "GC007"]
+    assert len(gc7) == 2
+    assert any("dtype mixing across a call boundary" in v.message for v in gc7)
+    assert any("rank drift" in v.message for v in gc7)
+
+
+def test_gc007_struct_field_mismatch(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/sim.py": '''\
+            """doc"""
+            from typing import NamedTuple
+            import jax.numpy as jnp
+
+            class St(NamedTuple):
+                term: jnp.ndarray  # gc: int32[P, G]
+
+            def make(
+                x,  # gc: bool[P, G]
+                y,  # gc: int32[P, G]
+            ):
+                bad = St(term=x)
+                good = St(term=y)
+                return bad, good
+            ''',
+        },
+    )
+    gc7 = [v for v in vs if v.rule_id == "GC007"]
+    assert len(gc7) == 1 and "St.term" in gc7[0].message
+
+
+def test_gc007_allow_marker_suppresses(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": (
+                '"""m <-> o"""\n'
+                "import jax.numpy as jnp\n\n"
+                "def m(x):  # gc: int32[P, G]\n"
+                f"    return jnp.sum(x, axis=0)  {MARK}GC007 — fixture "
+                "wants the widening\n"
+            ),
+        },
+    )
+    assert [v.rule_id for v in vs if v.rule_id == "GC007"] == []
+
+
+# --- GC008 plane-overflow ---
+
+_GC008_KERNELS_OK = '''\
+"""zero_health <-> o; update_health <-> o"""
+import jax.numpy as jnp
+
+HP_LEADERLESS = 0
+HP_SINCE_COMMIT = 1
+HP_TERM_BUMPS = 2
+HP_VOTE_SPLITS = 3
+N_HEALTH_PLANES = 4
+
+def zero_health(n_groups: int):
+    return jnp.zeros((N_HEALTH_PLANES, n_groups), jnp.int32)
+
+def update_health(planes, window_pos, window: int, has_leader,
+                  commit_advanced, term_bump, vote_split):
+    leaderless = jnp.where(has_leader, 0, planes[HP_LEADERLESS] + 1)
+    since = jnp.where(commit_advanced, 0, planes[HP_SINCE_COMMIT] + 1)
+    fresh = window_pos == 0
+    bumps = jnp.where(fresh, 0, planes[HP_TERM_BUMPS]) + term_bump
+    splits = planes[HP_VOTE_SPLITS] + vote_split.astype(jnp.int32)
+    return jnp.stack([leaderless, since, bumps, splits]), window_pos
+'''
+
+
+def test_gc008_registered_planes_pass(tmp_path):
+    vs = run_engine_on(
+        tmp_path, {"raft_tpu/multiraft/kernels.py": _GC008_KERNELS_OK}
+    )
+    assert [v.rule_id for v in vs if v.rule_id == "GC008"] == []
+
+
+def test_gc008_unregistered_plane_flags(tmp_path):
+    src = _GC008_KERNELS_OK.replace(
+        "N_HEALTH_PLANES = 4", "HP_NOVEL = 4\nN_HEALTH_PLANES = 5"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    gc8 = [v for v in vs if v.rule_id == "GC008"]
+    assert len(gc8) == 1 and "HP_NOVEL" in gc8[0].message
+
+
+def test_gc008_growth_bound_violation_flags(tmp_path):
+    src = _GC008_KERNELS_OK.replace(
+        "planes[HP_LEADERLESS] + 1", "planes[HP_LEADERLESS] + 2"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    gc8 = [v for v in vs if v.rule_id == "GC008"]
+    assert len(gc8) == 1 and "grows by up to 2" in gc8[0].message
+
+
+def test_gc008_unprovable_increment_flags(tmp_path):
+    src = _GC008_KERNELS_OK.replace(
+        "planes[HP_VOTE_SPLITS] + vote_split.astype(jnp.int32)",
+        "planes[HP_VOTE_SPLITS] + mystery_rate",
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    gc8 = [v for v in vs if v.rule_id == "GC008"]
+    assert len(gc8) == 1 and "cannot prove" in gc8[0].message
+
+
+_GC008_SIM = '''\
+"""doc"""
+
+class ClusterSim:
+    _DRAIN_MAX = 128
+
+    def __init__(self, cfg):
+        self._drain_cap = max(
+            1, min(self._DRAIN_MAX, ({cap}) // (256 * cfg.n_groups))
+        )
+
+    def _drain_counters(self):
+        v = -1
+        if v < 0:
+            raise RuntimeError("wrapped")
+'''
+
+
+def test_gc008_drain_cap_within_wrap_bound_passes(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {"raft_tpu/multiraft/sim.py": _GC008_SIM.format(cap="1 << 31")},
+    )
+    assert [v.rule_id for v in vs if v.rule_id == "GC008"] == []
+
+
+def test_gc008_drain_cadence_beyond_wrap_bound_flags(tmp_path):
+    # THE acceptance fixture: stretching the drain window budget past the
+    # int32 wrap bound (2**40 events per window) must fail the build.
+    vs = run_engine_on(
+        tmp_path,
+        {"raft_tpu/multiraft/sim.py": _GC008_SIM.format(cap="1 << 40")},
+    )
+    gc8 = [v for v in vs if v.rule_id == "GC008"]
+    assert len(gc8) == 1 and "wraps at 2**31" in gc8[0].message
+
+
+def test_gc008_missing_wrap_backstop_flags(tmp_path):
+    # The backstop check must look for the v<0 raise INSIDE
+    # _drain_counters: an unrelated raise elsewhere in the class (the
+    # "disabled" RuntimeErrors) must not satisfy it.
+    src = _GC008_SIM.format(cap="1 << 31").replace(
+        '        if v < 0:\n            raise RuntimeError("wrapped")\n',
+        "        return v\n",
+    )
+    src += (
+        "\n    def counters(self):\n"
+        '        raise RuntimeError("counters disabled")\n'
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/sim.py": src})
+    gc8 = [v for v in vs if v.rule_id == "GC008"]
+    assert len(gc8) == 1 and "backstop" in gc8[0].message
+
+
+# --- GC009 traced-escape ---
+
+
+def test_gc009_traced_into_static_param_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/sim.py": '''\
+            """doc"""
+
+            def helper(x, n: int):
+                return x * n
+
+            def step(cfg, x):
+                return helper(x, x.sum())
+            ''',
+        },
+    )
+    gc9 = [v for v in vs if v.rule_id == "GC009"]
+    assert len(gc9) == 1 and "`n` of helper()" in gc9[0].message
+
+
+def test_gc009_static_args_pass(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/sim.py": '''\
+            """doc"""
+
+            def helper(x, n: int):
+                return x * n
+
+            def step(cfg, x):
+                a = helper(x, cfg.n_groups)
+                b = helper(x, x.shape[0])
+                sub_cfg = cfg._replace(n_groups=4)
+                c = helper(x, n=sub_cfg.n_groups)
+                return a, b, c
+            ''',
+        },
+    )
+    assert [v.rule_id for v in vs if v.rule_id == "GC009"] == []
+
+
+def test_gc009_closure_statics_seen_in_nested_defs(tmp_path):
+    # GC003's per-body pass cannot see that `cfg` is static inside the
+    # nested fn; the call-graph-aware pass must (no false positive), while
+    # still catching the traced escape in the second nested fn.
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/sim.py": '''\
+            """doc"""
+
+            def helper(x, rounds: int):
+                return x * rounds
+
+            def factory(cfg, k: int):
+                def good(st):
+                    return helper(st, k)
+
+                def bad(st):
+                    return helper(st, st.sum())
+
+                return good, bad
+            ''',
+        },
+    )
+    gc9 = [v for v in vs if v.rule_id == "GC009"]
+    assert len(gc9) == 1 and "`rounds` of helper()" in gc9[0].message
+
+
+def test_gc009_cross_module_call_checked(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """tick <-> o"""
+
+            def tick(state, election_timeout: int):
+                return state + election_timeout
+            ''',
+            "raft_tpu/multiraft/sim.py": '''\
+            """doc"""
+            from . import kernels
+
+            def step(cfg, st):
+                return kernels.tick(st, st.max())
+            ''',
+        },
+    )
+    gc9 = [v for v in vs if v.rule_id == "GC009"]
+    assert len(gc9) == 1 and "`election_timeout` of tick()" in gc9[0].message
+
+
+# --- GC010 parity-obligations ---
+
+
+def test_gc010_unresolvable_oracle_symbol_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """Map:
+
+              mapped <-> quorum.Missing.thing
+            """
+
+            def mapped(x):
+                return x
+            ''',
+            "raft_tpu/quorum/__init__.py": "",
+        },
+    )
+    gc10 = [v for v in vs if v.rule_id == "GC010"]
+    assert len(gc10) == 1 and "does not resolve" in gc10[0].message
+
+
+def test_gc010_resolvable_oracle_passes_and_unmachine_checkable_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """Map:
+
+              good <-> quorum.MajorityConfig.committed_index
+              cited <-> scalar walk (reference: majority.rs:70-124)
+              vague <-> something handwavy with no anchor at all
+            """
+
+            def good(x):
+                return x
+
+            def cited(x):
+                return x
+
+            def vague(x):
+                return x
+            ''',
+            "raft_tpu/quorum/__init__.py": (
+                "from .majority import MajorityConfig\n"
+            ),
+            "raft_tpu/quorum/majority.py": (
+                "class MajorityConfig:\n"
+                "    def committed_index(self, l):\n"
+                "        return 0\n"
+            ),
+        },
+    )
+    gc10 = [v for v in vs if v.rule_id == "GC010"]
+    assert len(gc10) == 1
+    assert "vague" in gc10[0].message
+    assert "no machine-checkable oracle" in gc10[0].message
+
+
+def test_gc010_stale_baseline_flags(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/kernels.py": '''\
+            """Map:
+
+              mapped <-> scalar walk (reference: x.rs:1-2)
+            """
+
+            def mapped(x):
+                return x
+            ''',
+            "tools/graftcheck/parity_obligations.json": (
+                '{"version": 1, "obligations": '
+                '[{"kernel": "dropped_kernel"}]}\n'
+            ),
+        },
+    )
+    gc10 = [v for v in vs if v.rule_id == "GC010"]
+    assert len(gc10) == 1
+    assert "drifted" in gc10[0].message
+    assert "dropped_kernel" in gc10[0].message
+
+
+def test_engine_rules_listed_and_markers_validate(tmp_path):
+    # allow-GC007..GC010 markers must be KNOWN to the per-file run (a
+    # marker naming them is not a GC000 unknown-rule violation).
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/scalar.py",
+        f"{MARK}GC008 — engine rule marker is legal\n",
+    )
+    assert vs == []
+    from tools.graftcheck import all_rules as _all
+
+    ids_ = {r.id for r in _all()}
+    assert {"GC007", "GC008", "GC009", "GC010"} <= ids_
+
+
+# --- run cache + --changed-only (tools.graftcheck.__main__) ---
+
+
+def test_run_cache_replays_unchanged_tree(tmp_path, monkeypatch, capsys):
+    import tools.graftcheck.__main__ as gm
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("import jax.numpy as jnp\nx = jnp.zeros((4,))\n")
+    monkeypatch.chdir(tmp_path)
+    rc1 = gm.main(["raft_tpu"])
+    out1 = capsys.readouterr().out
+    assert rc1 == 1 and "GC001" in out1
+    # Second run must replay from cache: run_paths must not execute.
+    monkeypatch.setattr(
+        gm, "run_paths", lambda *a, **k: (_ for _ in ()).throw(AssertionError)
+    )
+    rc2 = gm.main(["raft_tpu"])
+    out2 = capsys.readouterr().out
+    assert rc2 == 1 and out2 == out1
+    # Touching the file misses the cache (mtime key) and re-runs.
+    monkeypatch.undo()
+    monkeypatch.chdir(tmp_path)
+    f.write_text("import jax.numpy as jnp\nx = jnp.zeros((4,), jnp.int32)\n")
+    assert gm.main(["raft_tpu"]) == 0
+
+
+def test_changed_only_scans_only_changed_files(tmp_path, monkeypatch, capsys):
+    import subprocess
+
+    import tools.graftcheck.__main__ as gm
+
+    def git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=tmp_path, capture_output=True, text=True
+        )
+
+    if git("init", "-q").returncode != 0:
+        import pytest
+
+        pytest.skip("git unavailable")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    clean = tmp_path / "raft_tpu" / "multiraft" / "clean.py"
+    clean.parent.mkdir(parents=True)
+    # A violation in a COMMITTED, unchanged file must not be reported.
+    clean.write_text("import jax.numpy as jnp\nx = jnp.zeros((4,))\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    dirty = tmp_path / "raft_tpu" / "multiraft" / "dirty.py"
+    dirty.write_text("import jax.numpy as jnp\ny = jnp.ones((4,))\n")
+    monkeypatch.chdir(tmp_path)
+    rc = gm.main(["--changed-only", "raft_tpu"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "dirty.py" in out and "clean.py" not in out
+    # A DELETION falls back to the full scan: violations for a vanished
+    # file anchor in unchanged files, so filtering would miss them.
+    clean.unlink()
+    rc = gm.main(["--changed-only", "raft_tpu"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "full scan" in captured.err
+
+
+def test_rule_filter_on_engine_rule_requires_engine(tmp_path, monkeypatch, capsys):
+    import tools.graftcheck.__main__ as gm
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    # `--rule GC008` without --engine would otherwise exit 0 having run
+    # NOTHING (engine rules never apply per-file) — a silent green.
+    rc = gm.main(["--rule", "GC008", "raft_tpu"])
+    assert rc == 2
+    assert "--engine" in capsys.readouterr().err

@@ -270,3 +270,35 @@ def test_explain_matches_planes():
         assert info["health"][name] == planes[i, 2]
     assert len(info["peers"]["term"]) == P
     assert info["health"]["ticks_since_commit"] > 0
+
+
+# --- GC010 parity obligations (tools/graftcheck/parity_obligations.json) ---
+
+
+def test_health_obligations_exercised():
+    """Every obligation assigned to this suite (the health kernels) must be
+    exercised HERE: the run_parity harness drives zero_health/update_health
+    through ClusterSim(collect_health=True) every round, and the unit tests
+    above call all three kernels directly.  A new health kernel fails this
+    until the suite covers it."""
+    import json
+    from pathlib import Path
+
+    base = Path(__file__).resolve().parent.parent
+    doc = json.loads(
+        (base / "tools" / "graftcheck" / "parity_obligations.json").read_text(
+            encoding="utf-8"
+        )
+    )
+    mine = {
+        o["kernel"]
+        for o in doc["obligations"]
+        if o["parity_suite"].endswith("test_health_parity.py")
+    }
+    assert mine == {"zero_health", "update_health", "health_summary"}
+    for o in doc["obligations"]:
+        if o["parity_suite"].endswith("test_health_parity.py"):
+            assert "tests/test_health_parity.py" in o["tests"], (
+                f"obligation {o['kernel']} is assigned to this suite but "
+                "not exercised by it"
+            )

@@ -262,3 +262,24 @@ def test_tick_kernel_matches_scalar_counters():
     assert not bool(heartbeat[3]) and int(hb2[3]) == 1
     # candidate 4: timeout but not promotable
     assert not bool(campaign[4]) and int(ee2[4]) == 9
+
+
+def test_device_plane_dtypes_stay_int32():
+    """Regression for the GC007 x64-widening fixes: every value a kernel
+    hands back toward the planes/host boundary is int32 regardless of
+    backend flags (a bare jnp.sum would widen to int64 under x64 — caught
+    statically by graftcheck --engine, pinned at runtime here)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.multiraft import kernels
+
+    ctrs = kernels.zero_counters()
+    mask = jnp.zeros((3, 4), bool)
+    delta = jnp.zeros((3, 4), jnp.int32)
+    out = kernels.count_events(ctrs, mask, mask, mask, delta)
+    assert out.dtype == jnp.int32
+
+    planes = kernels.zero_health(8)
+    counts, hist, ids, scores = kernels.health_summary(planes, 2, 4, 3, 4)
+    for arr in (counts, hist, ids, scores):
+        assert arr.dtype == jnp.int32

@@ -147,3 +147,85 @@ def test_parity_majority_crash_stalls_commit():
         return crashed, np.full(G, 1, np.int64)
 
     run_parity(G, P, 110, schedule)
+
+
+# --- GC010 parity obligations (tools/graftcheck/parity_obligations.json) ---
+
+# Obligations this suite acknowledges owning: their oracle claim is the
+# bit-identical trajectory driven above (quorum commit, vote resolution,
+# tick timers, and the timeout PRNG are all embedded in every compared
+# round), backed by the direct kernel tests each obligation lists.  A NEW
+# public kernel (or a retired one) changes the extracted obligations and
+# fails test_parity_obligations_fresh_and_covered until this set — and the
+# schedules, if the kernel adds protocol behavior — acknowledge it.
+SIM_SUITE_OBLIGATIONS = {
+    "append_response_update",
+    "committed_index",
+    "committed_index_grouped",
+    "joint_committed_index",
+    "joint_vote_result",
+    "majority_of",
+    "tick_kernel",
+    "timeout_draw",
+    "vote_result",
+}
+
+
+def _load_obligations():
+    import json
+    from pathlib import Path
+
+    base = Path(__file__).resolve().parent.parent
+    path = base / "tools" / "graftcheck" / "parity_obligations.json"
+    return base, json.loads(path.read_text(encoding="utf-8"))
+
+
+def test_parity_obligations_fresh_and_covered():
+    """The committed obligations baseline matches a fresh extraction, lists
+    every public kernel, and every obligation is exercised by at least one
+    test — the local twin of the CI baseline-diff job."""
+    import inspect
+
+    from tools.graftcheck.core import Context, SourceFile
+    from tools.graftcheck.engine.obligations import extract
+
+    import raft_tpu.multiraft.kernels as kernels_mod
+
+    base, committed = _load_obligations()
+    sf = SourceFile(
+        base / "raft_tpu" / "multiraft" / "kernels.py",
+        "raft_tpu/multiraft/kernels.py",
+    )
+    ctx = Context(
+        repo_root=base, tests_root=base / "tests", reference_root=None
+    )
+    document, extraction_violations = extract(sf, ctx)
+    assert extraction_violations == []
+    assert document == committed, (
+        "parity_obligations.json is stale; regenerate with "
+        "`make obligations` and review the diff"
+    )
+    public = {
+        n
+        for n, f in inspect.getmembers(kernels_mod, inspect.isfunction)
+        if f.__module__ == kernels_mod.__name__ and not n.startswith("_")
+    }
+    obls = committed["obligations"]
+    assert {o["kernel"] for o in obls} == public
+    for o in obls:
+        assert o["tests"], f"obligation {o['kernel']} has no covering test"
+
+
+def test_parity_obligations_sim_suite_acknowledged():
+    """Every obligation assigned to THIS suite is acknowledged above."""
+    _, committed = _load_obligations()
+    mine = {
+        o["kernel"]
+        for o in committed["obligations"]
+        if o["parity_suite"].endswith("test_sim_parity.py")
+    }
+    assert mine == SIM_SUITE_OBLIGATIONS, (
+        "sim-suite parity obligations changed; extend the schedules (or "
+        "the acknowledgment set) for: "
+        f"{sorted(mine ^ SIM_SUITE_OBLIGATIONS)}"
+    )

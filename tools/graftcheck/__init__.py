@@ -11,6 +11,13 @@ Rules (each with a `# graftcheck: allow-<rule> — <why>` escape hatch):
   GC004 metrics-guarded            metrics hooks behind the enabled-check
   GC005 citation-check             file:line cites well-formed + resolvable
   GC006 kernel-parity-map          kernels mapped to oracles and tested
+
+Engine rules (cross-module abstract interpretation; run with --engine):
+
+  GC007 shape-dtype                whole-program shape/dtype inference
+  GC008 plane-overflow             int32 planes cannot wrap between drains
+  GC009 traced-escape              no traced values into static-claimed params
+  GC010 parity-obligations         kernel obligations extracted + baselined
 """
 
 from .core import Context, Rule, SourceFile, Violation, run_paths

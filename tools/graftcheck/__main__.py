@@ -1,14 +1,28 @@
-"""CLI entry point: python -m tools.graftcheck <paths...>"""
+"""CLI entry point: python -m tools.graftcheck <paths...>
+
+Flags beyond the basics (docs/STATIC_ANALYSIS.md):
+
+  --engine             also run the cross-module abstract-interpretation
+                       rules GC007-GC010 (make lint / CI pass this)
+  --changed-only       scan only files changed vs --diff-base (default:
+                       merge-base with origin/main, falling back to main,
+                       then HEAD); the CI lint job uses this on PR diffs
+  --emit-obligations P write the GC010 parity-obligations JSON to P
+  --no-cache           skip the mtime-keyed run cache
+"""
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Set, Tuple
 
-from .core import Context, run_paths
+from . import cache as cache_mod
+from .core import Context, Violation, run_paths
+from .engine import extract_obligations, run_engine
 from .rules import all_rules
 
 
@@ -19,6 +33,68 @@ def _auto_tests_root(paths: List[str], repo_root: Path) -> Optional[Path]:
             return p
     fallback = repo_root / "tests"
     return fallback if fallback.is_dir() else None
+
+
+def _git_changed_files(
+    repo_root: Path, base: Optional[str]
+) -> "Optional[Tuple[Set[Path], bool]]":
+    """(changed files vs base ref + working tree, full_scan_needed); None
+    when git is unavailable (caller falls back to a full run).
+
+    full_scan_needed is True when the diff deletes or renames files —
+    violations for a vanished file anchor in the UNCHANGED files that
+    cite/cover it (GC005 cites, GC006 test coverage), so a filtered scan
+    would miss them — or when the diff touches tools/graftcheck/ itself
+    (a changed linter must re-prove the whole tree, not skip it)."""
+
+    def run(*args: str) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ["git", *args],
+                cwd=repo_root,
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [line for line in proc.stdout.splitlines() if line.strip()]
+
+    bases = [base] if base else ["origin/main", "main", "HEAD"]
+    diff: Optional[List[str]] = None
+    statuses: Optional[List[str]] = None
+    for b in bases:
+        merge_base = run("merge-base", b, "HEAD")
+        ref = merge_base[0] if merge_base else b
+        diff = run("diff", "--name-only", ref)
+        if diff is not None:
+            statuses = run("diff", "--name-status", ref)
+            break
+    if diff is None:
+        return None
+    # -uall: a brand-new directory must list its FILES, not collapse to a
+    # single `?? dir/` entry no per-file comparison would ever match.
+    status = run("status", "--porcelain", "-uall") or []
+    out: Set[Path] = set()
+    full_scan = False
+    for name in diff:
+        out.add((repo_root / name).resolve())
+        if name.startswith("tools/graftcheck/"):
+            full_scan = True
+    for line in statuses or []:
+        if line[:1] in ("D", "R"):
+            full_scan = True
+    for line in status:
+        code, name = line[:2], line[3:].split(" -> ")[-1].strip()
+        if name:
+            out.add((repo_root / name).resolve())
+            if name.startswith("tools/graftcheck/"):
+                full_scan = True
+        if "D" in code or "R" in code:
+            full_scan = True
+    return out, full_scan
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -32,6 +108,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="append",
         default=None,
         help="run only these rules (GC id or slug; repeatable)",
+    )
+    ap.add_argument(
+        "--engine",
+        action="store_true",
+        help="also run the cross-module engine rules GC007-GC010",
+    )
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="scan only files changed vs --diff-base (default: merge-base "
+        "with origin/main, then main, then HEAD); cross-module rules "
+        "still see their whole module set",
+    )
+    ap.add_argument(
+        "--diff-base",
+        default=None,
+        metavar="REF",
+        help="base ref for --changed-only (e.g. origin/main on a PR)",
+    )
+    ap.add_argument(
+        "--emit-obligations",
+        default=None,
+        metavar="PATH",
+        help="write the GC010 parity-obligations JSON to PATH and exit",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the mtime-keyed run cache (.graftcheck-cache.json)",
     )
     ap.add_argument(
         "--tests-root",
@@ -57,6 +162,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if not args.paths:
         ap.error("the following arguments are required: paths")
+    wanted: Optional[Set[str]] = None
     if args.rule:
         wanted = {w.lower() for w in args.rule}
         rules = [
@@ -66,6 +172,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         ]
         if not rules:
             print(f"no rules match {sorted(wanted)}", file=sys.stderr)
+            return 2
+        from .engine.rules import engine_rules
+
+        engine_selected = {
+            r.id
+            for r in engine_rules()
+            if r.id.lower() in wanted or r.slug.lower() in wanted
+        }
+        if engine_selected and not args.engine:
+            # Without this, `--rule GC008` would exit 0 having run NOTHING
+            # (engine rules never apply per-file) — a silent green.
+            print(
+                f"{'/'.join(sorted(engine_selected))} are engine rules; "
+                "add --engine to run them",
+                file=sys.stderr,
+            )
             return 2
 
     repo_root = Path.cwd()
@@ -83,7 +205,98 @@ def main(argv: Optional[List[str]] = None) -> int:
         ),
         reference_root=ref_root,
     )
-    violations = run_paths(args.paths, rules, ctx, known_rules=all_rules())
+
+    if args.emit_obligations:
+        extracted = extract_obligations(args.paths, ctx)
+        if extracted is None:
+            print(
+                "kernels.py not in the scanned paths; nothing to extract",
+                file=sys.stderr,
+            )
+            return 2
+        _, rendered = extracted
+        out_path = Path(args.emit_obligations)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(rendered, encoding="utf-8")
+        print(f"wrote {out_path}")
+        return 0
+
+    scan_paths = list(args.paths)
+    if args.changed_only:
+        result = _git_changed_files(repo_root, args.diff_base)
+        if result is not None:
+            changed, full_scan = result
+            if full_scan:
+                print(
+                    "graftcheck: diff deletes/renames files or touches the "
+                    "linter itself; running the full scan",
+                    file=sys.stderr,
+                )
+            else:
+                from .core import collect_files
+
+                kept = [
+                    str(p)
+                    for p in collect_files(scan_paths)
+                    if p.resolve() in changed
+                ]
+                if not kept:
+                    print(
+                        "graftcheck: no scanned files changed",
+                        file=sys.stderr,
+                    )
+                    return 0
+                scan_paths = kept
+
+    # The cache fingerprints repo files only; a reference checkout (GC005
+    # .rs-cite resolution) can change without any repo mtime moving, so its
+    # presence disables caching rather than risking stale replays.
+    use_cache = (
+        not args.no_cache
+        and not args.changed_only
+        and ctx.reference_root is None
+    )
+    options_key = "|".join(
+        [
+            "engine" if args.engine else "plain",
+            ",".join(sorted(args.rule or [])),
+            ",".join(sorted(str(Path(p)) for p in args.paths)),
+            str(ctx.tests_root or ""),
+            str(ctx.reference_root or ""),
+        ]
+    )
+    files_fp = (
+        cache_mod.fingerprint(scan_paths, repo_root, ctx.tests_root)
+        if use_cache
+        else {}
+    )
+    violations: Optional[List[Violation]]
+    if use_cache:
+        violations = cache_mod.load(repo_root, options_key, files_fp)
+    else:
+        violations = None
+    if violations is None:
+        violations = run_paths(scan_paths, rules, ctx, known_rules=all_rules())
+        if args.engine:
+            engine_scope = scan_paths
+            if args.changed_only:
+                # Cross-module analyses need their WHOLE module set even
+                # when only one file changed; widen back to the originals.
+                engine_scope = list(args.paths)
+            engine_violations = run_engine(engine_scope, ctx)
+            if wanted is not None:
+                engine_violations = [
+                    v
+                    for v in engine_violations
+                    if v.rule_id.lower() in wanted
+                    or v.slug.lower() in wanted
+                ]
+            violations = sorted(
+                violations + engine_violations,
+                key=lambda v: (v.path, v.line, v.rule_id),
+            )
+        if use_cache:
+            cache_mod.store(repo_root, options_key, files_fp, violations)
     for v in violations:
         print(v.render())
     if violations:

@@ -1,0 +1,120 @@
+"""Run cache: replay a whole graftcheck run when nothing changed.
+
+Rules are cross-file (GC006 reads tests/, the engine reads five modules at
+once, GC010 reads the committed baseline), so per-file caching would need
+a dependency graph; instead the WHOLE run is keyed on a fingerprint of
+every file that can influence it — the scanned set, the tests root, the
+graftcheck sources themselves, the whole raft_tpu package (GC010's oracle
+resolver reads beyond the scan paths), and the obligations baseline —
+plus the effective options.  Any mtime/size change anywhere misses; an unchanged
+tree replays the stored violations in well under the ~2s budget
+(docs/STATIC_ANALYSIS.md).  The cache file lives at the repo root
+(`.graftcheck-cache.json`, gitignored) and is best-effort: unreadable or
+stale-format caches are ignored, write failures are silent.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .core import Violation, collect_files
+
+CACHE_NAME = ".graftcheck-cache.json"
+CACHE_FORMAT = 2  # bump to invalidate every existing cache
+
+
+def _stat_key(path: Path) -> Optional[List[int]]:
+    try:
+        st = path.stat()
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
+
+
+def fingerprint(
+    paths: Sequence[str], repo_root: Path, tests_root: Optional[Path]
+) -> Dict[str, List[int]]:
+    """path -> (mtime_ns, size) over everything that can change the run."""
+    files: Dict[str, List[int]] = {}
+
+    def add(p: Path) -> None:
+        key = str(p)
+        stat = _stat_key(p)
+        if stat is not None:
+            files[key] = stat
+
+    for p in collect_files(paths):
+        add(p)
+    if tests_root is not None and tests_root.is_dir():
+        for p in sorted(tests_root.rglob("*.py")):
+            add(p)
+    tool_root = Path(__file__).resolve().parent
+    for p in sorted(tool_root.rglob("*.py")):
+        add(p)
+    # GC010's oracle resolver reads arbitrary raft_tpu modules (dotted
+    # symbols, re-exports) even when the scan paths are narrower, so the
+    # whole package is part of the fingerprint.
+    pkg = repo_root / "raft_tpu"
+    if pkg.is_dir():
+        for p in sorted(pkg.rglob("*.py")):
+            add(p)
+    add(repo_root / "tools" / "graftcheck" / "parity_obligations.json")
+    return files
+
+
+def load(
+    repo_root: Path,
+    options_key: str,
+    files: Dict[str, List[int]],
+) -> Optional[List[Violation]]:
+    cache_path = repo_root / CACHE_NAME
+    try:
+        data = json.loads(cache_path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("format") != CACHE_FORMAT:
+        return None
+    run = data.get("runs", {}).get(options_key)
+    if not isinstance(run, dict) or run.get("files") != files:
+        return None
+    out: List[Violation] = []
+    for row in run.get("violations", []):
+        if not (isinstance(row, list) and len(row) == 5):
+            return None
+        out.append(
+            Violation(
+                str(row[0]), int(row[1]), str(row[2]), str(row[3]),
+                str(row[4]),
+            )
+        )
+    return out
+
+
+def store(
+    repo_root: Path,
+    options_key: str,
+    files: Dict[str, List[int]],
+    violations: Sequence[Violation],
+) -> None:
+    cache_path = repo_root / CACHE_NAME
+    data: Dict[str, object] = {"format": CACHE_FORMAT, "runs": {}}
+    try:
+        old = json.loads(cache_path.read_text(encoding="utf-8"))
+        if isinstance(old, dict) and old.get("format") == CACHE_FORMAT:
+            data = old
+    except (OSError, json.JSONDecodeError):
+        pass
+    runs = data.setdefault("runs", {})
+    assert isinstance(runs, dict)
+    runs[options_key] = {
+        "files": files,
+        "violations": [list(v) for v in violations],
+    }
+    try:
+        cache_path.write_text(
+            json.dumps(data, sort_keys=True), encoding="utf-8"
+        )
+    except OSError:
+        pass

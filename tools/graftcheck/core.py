@@ -124,8 +124,13 @@ def apply_markers(
     violations: Sequence[Violation],
     rules: Sequence[Rule],
     markers: Sequence[AllowMarker],
+    emit_gc000: bool = True,
 ) -> List[Violation]:
-    """Filter suppressed violations; emit GC000 for bad markers."""
+    """Filter suppressed violations; emit GC000 for bad markers.
+
+    ``emit_gc000=False`` is the engine's suppress-only mode: the normal
+    per-file run has already validated this file's markers, so a second
+    pass over the same file must not duplicate the GC000s."""
     by_slug = {r.slug.lower(): r for r in rules}
     by_id = {r.id.lower(): r for r in rules}
 
@@ -155,6 +160,8 @@ def apply_markers(
                 break
         if not suppressed:
             kept.append(v)
+    if not emit_gc000:
+        return kept
     for m in markers:
         known = m.rule.lower() in by_slug or m.rule.lower() in by_id
         if not known:

@@ -1,0 +1,109 @@
+"""graftcheck engine: interprocedural abstract interpretation (GC007-010).
+
+Entry point: ``run_engine(paths, ctx)`` — assembles whichever engine
+modules (kernels/sim/pallas_step/simref/driver) appear in the scanned
+paths, runs the four cross-module analyses, and returns allow-marker-
+filtered violations.  The per-file rules stay in ``tools.graftcheck.rules``;
+this package holds everything that needs the whole call graph at once.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core import (
+    Context,
+    SourceFile,
+    Violation,
+    apply_markers,
+    collect_files,
+    find_markers,
+)
+from . import obligations as obligations_mod
+from . import overflow
+from .interp import build_program
+from .rules import check_traced_escape, engine_rules
+
+__all__ = ["run_engine", "extract_obligations", "engine_rules"]
+
+
+def _load_files(paths: Sequence[str]) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    for path in collect_files(paths):
+        if path.suffix != ".py":
+            continue
+        try:
+            out.append(SourceFile(path, str(path)))
+        except SyntaxError:
+            continue  # the per-file run reports the parse error
+    return out
+
+
+def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
+    files = _load_files(paths)
+    violations: List[Violation] = []
+
+    # GC007: whole-program shape/dtype inference.
+    program = build_program(files)
+    program.analyze()
+    violations.extend(program.violations)
+
+    # GC008: plane-overflow bounds over kernels.py + sim.py.
+    kernels_sf = _module_file(files, "raft_tpu/multiraft/kernels.py")
+    sim_sf = _module_file(files, "raft_tpu/multiraft/sim.py")
+    if kernels_sf is not None:
+        violations.extend(overflow.check_kernels(kernels_sf))
+    if sim_sf is not None:
+        violations.extend(overflow.check_sim(sim_sf))
+
+    # GC009: traced escape across call boundaries.
+    violations.extend(check_traced_escape(files, ctx))
+
+    # GC010: parity obligations + baseline freshness.
+    if kernels_sf is not None:
+        document, obl_violations = obligations_mod.extract(kernels_sf, ctx)
+        violations.extend(obl_violations)
+        violations.extend(
+            obligations_mod.check_baseline(kernels_sf, ctx, document)
+        )
+
+    # Allow-marker suppression (GC000 validation already happened in the
+    # per-file run over the same files).
+    by_path: Dict[str, List[Violation]] = defaultdict(list)
+    for v in violations:
+        by_path[v.path].append(v)
+    sf_by_path = {sf.display_path: sf for sf in files}
+    rules = engine_rules()
+    kept: List[Violation] = []
+    for path, vs in by_path.items():
+        sf = sf_by_path.get(path)
+        if sf is None:
+            kept.extend(vs)
+            continue
+        markers = find_markers(sf)
+        kept.extend(apply_markers(sf, vs, rules, markers, emit_gc000=False))
+    kept.sort(key=lambda v: (v.path, v.line, v.rule_id))
+    return kept
+
+
+def extract_obligations(
+    paths: Sequence[str], ctx: Context
+) -> Optional[Tuple[Dict[str, object], str]]:
+    """The obligations document (and its rendered JSON) for --emit; None
+    when kernels.py is not in the scanned set."""
+    files = _load_files(paths)
+    kernels_sf = _module_file(files, "raft_tpu/multiraft/kernels.py")
+    if kernels_sf is None:
+        return None
+    document, _ = obligations_mod.extract(kernels_sf, ctx)
+    return document, obligations_mod.render(document)
+
+
+def _module_file(
+    files: Sequence[SourceFile], suffix: str
+) -> Optional[SourceFile]:
+    for sf in files:
+        if sf.norm().endswith(suffix):
+            return sf
+    return None

@@ -1,0 +1,1249 @@
+"""Interprocedural abstract interpreter over the device modules.
+
+One pass per module, modules in import-dependency order (kernels before
+sim before pallas_step), so call sites always see their callee's summary.
+Each module-level function is analyzed exactly once with an environment
+seeded from its anchors (``# gc:`` comments — see docs/STATIC_ANALYSIS.md)
+and annotations; nested functions are analyzed inline with a snapshot of
+the enclosing environment (closure capture).  The analysis is a single
+forward walk in source order (the same discipline as GC003's staticness
+pass): branch bodies are treated as straight-line code, last binding wins.
+That is unsound in general and fine for a linter — every check below
+fires only on PROVABLE facts, so imprecision can only lose findings,
+never invent them.
+
+Checks emitted here (rule GC007, slug shape-dtype):
+
+  * additive reductions (``jnp.sum``/``jnp.prod``, the ``.sum()`` method)
+    without an explicit ``dtype=`` whose result is not immediately
+    ``.astype()``-cast or compared: under x64 these widen int32/bool
+    operands to int64 — silently, because the non-x64 CI suite truncates
+    everything back to int32 (see the promotion probes in
+    docs/STATIC_ANALYSIS.md);
+  * binary/ternary ops mixing two KNOWN dtypes whose jnp promotion is
+    strictly wider than both operands (int32 x uint32 -> int64);
+  * arithmetic between a bool array and a Python scalar (int32 vs int64
+    depending on x64 — use ``.astype`` first);
+  * arithmetic on index-typed values (argsort/argmax results: int32 vs
+    int64 depending on x64) — indexing with them is fine;
+  * provably non-broadcastable shapes (two unequal int dims, neither 1);
+  * call-boundary mismatches: an argument whose known dtype or fixed rank
+    contradicts the callee parameter's anchor;
+  * struct construction/_replace with a field value whose known dtype
+    contradicts the registered field spec.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import SourceFile, Violation, walk_local
+from .lattice import (
+    BOOL,
+    ELLIPSIS,
+    INDEX,
+    INT32,
+    UNKNOWN,
+    AbstractValue,
+    Arr,
+    Dim,
+    Shape,
+    Static,
+    Struct,
+    TupleVal,
+    broadcast,
+    join,
+    parse_spec,
+    promote,
+    reduce_shape,
+    spec_rank,
+    widens,
+)
+
+GC007 = "GC007"
+GC007_SLUG = "shape-dtype"
+
+# The module set the engine reasons about, keyed by short name.  Order is
+# import-dependency order: callees are summarized before their callers.
+ENGINE_MODULES: Tuple[Tuple[str, str], ...] = (
+    ("kernels", "raft_tpu/multiraft/kernels.py"),
+    ("sim", "raft_tpu/multiraft/sim.py"),
+    ("pallas_step", "raft_tpu/multiraft/pallas_step.py"),
+    ("simref", "raft_tpu/multiraft/simref.py"),
+    ("driver", "raft_tpu/multiraft/driver.py"),
+)
+
+_ANCHOR_RE = re.compile(r"#\s*gc:\s*(?P<spec>[^#]+?)(?:\s+[-—;].*)?$")
+
+# jnp constructors with a positional dtype slot (mirrors GC001).
+_CTOR_DTYPE_POS = {
+    "zeros": 1,
+    "ones": 1,
+    "full": 2,
+    "arange": 3,
+    "asarray": 1,
+    "array": 1,
+}
+_DTYPE_CASTS = {
+    "int8", "uint8", "int16", "uint16", "int32", "uint32", "int64",
+    "uint64", "float32", "float64",
+}
+_REDUCTIONS_ADDITIVE = {"sum", "prod"}
+_REDUCTIONS_EXTREME = {"max", "min", "amax", "amin"}
+_REDUCTIONS_BOOL = {"any", "all"}
+_REDUCTIONS_INDEX = {"argmax", "argmin"}
+_ELEMENTWISE_BINARY = {
+    "maximum", "minimum", "add", "subtract", "multiply", "mod",
+    "floor_divide", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "logical_and", "logical_or",
+}
+_DTYPE_PRESERVING_UNARY = {
+    "sort", "clip", "abs", "negative", "flip", "roll", "transpose",
+    "reshape", "squeeze", "expand_dims", "broadcast_to", "tile",
+}
+_STATIC_ANNOTATIONS = {"int", "bool", "str", "float"}
+
+
+class FieldSpec:
+    """One struct field: its abstract value and whether it was anchored."""
+
+    __slots__ = ("value", "anchored")
+
+    def __init__(self, value: AbstractValue, anchored: bool):
+        self.value = value
+        self.anchored = anchored
+
+
+class StructInfo:
+    """A registered NamedTuple-like struct (SimState/HealthState/...).
+
+    ``all_static`` marks config structs (every field int/bool): unknown
+    attribute reads fall back to Static (properties like min_timeout)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.fields: Dict[str, FieldSpec] = {}
+        self.all_static = False
+
+
+class FunctionInfo:
+    """Summary of one module-level function."""
+
+    def __init__(self, module: str, node: ast.FunctionDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.params: List[str] = [a.arg for a in node.args.args]
+        self.kwonly: List[str] = [a.arg for a in node.args.kwonlyargs]
+        self.anchors: Dict[str, AbstractValue] = {}
+        self.static_params: Set[str] = set()
+        self.returns: AbstractValue = UNKNOWN
+        self.analyzed = False
+
+
+class ModuleInfo:
+    def __init__(self, name: str, sf: SourceFile):
+        self.name = name
+        self.sf = sf
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.aliases: Dict[str, str] = {}  # local name -> engine module name
+        self.constants: Dict[str, AbstractValue] = {}
+
+
+Reporter = Callable[[SourceFile, int, str], None]
+
+
+def anchor_on_line(sf: SourceFile, lineno: int) -> Optional[str]:
+    """The raw ``# gc:`` spec text on a 1-based source line, if any."""
+    if 1 <= lineno <= len(sf.lines):
+        m = _ANCHOR_RE.search(sf.lines[lineno - 1])
+        if m:
+            return m.group("spec").strip()
+    return None
+
+
+class Program:
+    """Cross-module state: struct registry + per-module tables."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.structs: Dict[str, StructInfo] = {}
+        self.violations: List[Violation] = []
+
+    # -- discovery ---------------------------------------------------------
+
+    def add_module(self, name: str, sf: SourceFile) -> None:
+        mi = ModuleInfo(name, sf)
+        self.modules[name] = mi
+        short_names = {n for n, _ in ENGINE_MODULES}
+        for node in ast.iter_child_nodes(sf.ast_tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name in short_names:
+                        mi.aliases[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.FunctionDef):
+                mi.functions[node.name] = self._function_info(name, sf, node)
+            elif isinstance(node, ast.ClassDef):
+                self._register_struct(sf, node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mi.constants[t.id] = Static(node.value.value)
+            elif isinstance(node, ast.Assign):
+                # e.g. INF = jnp.int32(2**31 - 1), tuples of constants
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mi.constants[t.id] = _module_const_value(node.value)
+
+    def _register_struct(self, sf: SourceFile, node: ast.ClassDef) -> None:
+        if not any(
+            (isinstance(b, ast.Name) and b.id == "NamedTuple")
+            or (isinstance(b, ast.Attribute) and b.attr == "NamedTuple")
+            for b in node.bases
+        ):
+            return
+        si = StructInfo(node.name)
+        static_only = True
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+                stmt.target, ast.Name
+            ):
+                continue
+            fname = stmt.target.id
+            spec_text = anchor_on_line(sf, stmt.lineno)
+            if spec_text is not None:
+                spec = parse_spec(spec_text, self.structs)
+                if spec is None:
+                    self.report(
+                        sf,
+                        stmt.lineno,
+                        f"unparseable anchor `# gc: {spec_text}` on struct "
+                        f"field {node.name}.{fname}",
+                    )
+                    spec = UNKNOWN
+                si.fields[fname] = FieldSpec(spec, True)
+                static_only = static_only and isinstance(spec, Static)
+            elif (
+                isinstance(stmt.annotation, ast.Name)
+                and stmt.annotation.id in _STATIC_ANNOTATIONS
+            ):
+                si.fields[fname] = FieldSpec(Static(), False)
+            else:
+                si.fields[fname] = FieldSpec(UNKNOWN, False)
+                static_only = False
+        si.all_static = static_only and bool(si.fields)
+        self.structs[node.name] = si
+
+    def _function_info(
+        self, module: str, sf: SourceFile, node: ast.FunctionDef
+    ) -> FunctionInfo:
+        fi = FunctionInfo(module, node)
+        for arg in node.args.args + node.args.kwonlyargs:
+            spec_text = anchor_on_line(sf, arg.lineno)
+            ann = arg.annotation
+            if spec_text is not None:
+                spec = parse_spec(spec_text, self.structs)
+                if spec is None:
+                    self.report(
+                        sf,
+                        arg.lineno,
+                        f"unparseable anchor `# gc: {spec_text}` on "
+                        f"parameter {node.name}({arg.arg})",
+                    )
+                    spec = UNKNOWN
+                fi.anchors[arg.arg] = spec
+                if isinstance(spec, Static):
+                    fi.static_params.add(arg.arg)
+                continue
+            if isinstance(ann, ast.Name):
+                if ann.id in _STATIC_ANNOTATIONS:
+                    fi.anchors[arg.arg] = Static()
+                    fi.static_params.add(arg.arg)
+                elif ann.id in self.structs:
+                    fi.anchors[arg.arg] = Struct(ann.id)
+                    if self.structs[ann.id].all_static:
+                        fi.static_params.add(arg.arg)
+            if arg.arg == "cfg" and arg.arg not in fi.anchors:
+                # GC003's convention: a parameter named cfg is the static
+                # SimConfig.
+                if "SimConfig" in self.structs:
+                    fi.anchors[arg.arg] = Struct("SimConfig")
+                else:
+                    fi.anchors[arg.arg] = Static()
+                fi.static_params.add(arg.arg)
+        return fi
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, sf: SourceFile, lineno: int, message: str) -> None:
+        self.violations.append(
+            Violation(sf.display_path, lineno, GC007, GC007_SLUG, message)
+        )
+
+    # -- analysis ----------------------------------------------------------
+
+    def analyze(self) -> None:
+        for name, _ in ENGINE_MODULES:
+            mi = self.modules.get(name)
+            if mi is None:
+                continue
+            for fi in mi.functions.values():
+                self.analyze_function(mi, fi)
+
+    def analyze_function(self, mi: ModuleInfo, fi: FunctionInfo) -> None:
+        if fi.analyzed:
+            return
+        fi.analyzed = True  # set first: recursion terminates at UNKNOWN
+        env: Dict[str, AbstractValue] = {}
+        for p in fi.params + fi.kwonly:
+            env[p] = fi.anchors.get(p, UNKNOWN)
+        if fi.node.args.vararg:
+            env[fi.node.args.vararg.arg] = UNKNOWN
+        if fi.node.args.kwarg:
+            env[fi.node.args.kwarg.arg] = UNKNOWN
+        interp = _FunctionInterp(self, mi, env)
+        fi.returns = interp.run(fi.node)
+
+    def resolve_call(
+        self, mi: ModuleInfo, func: ast.expr
+    ) -> Optional[FunctionInfo]:
+        """A Call's target as a known module-level function, if resolvable."""
+        if isinstance(func, ast.Name):
+            return mi.functions.get(func.id)
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            target = mi.aliases.get(func.value.id)
+            if target and target in self.modules:
+                return self.modules[target].functions.get(func.attr)
+        return None
+
+
+def _module_const_value(node: ast.expr) -> AbstractValue:
+    """Abstract value of a module-level assignment RHS (constants, constant
+    tuples, jnp scalar casts)."""
+    if isinstance(node, ast.Constant):
+        return Static(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+        isinstance(e, ast.Constant) for e in node.elts
+    ):
+        return Static(tuple(e.value for e in node.elts))  # type: ignore[misc]
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "jnp"
+        and node.func.attr in _DTYPE_CASTS
+    ):
+        return Arr(node.func.attr, ())
+    if isinstance(node, ast.BinOp):
+        return Static()
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            return Static()
+    return UNKNOWN
+
+
+def _dtype_of_node(node: ast.expr) -> Optional[str]:
+    """dtype named by an expression like ``jnp.int32`` / ``bool``."""
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_CASTS:
+        return node.attr
+    if isinstance(node, ast.Attribute) and node.attr == "bool_":
+        return BOOL
+    if isinstance(node, ast.Name) and node.id == "bool":
+        return BOOL
+    return None
+
+
+class _FunctionInterp:
+    """Forward walk over one function body."""
+
+    def __init__(
+        self,
+        program: Program,
+        mi: ModuleInfo,
+        env: Dict[str, AbstractValue],
+    ):
+        self.p = program
+        self.mi = mi
+        self.sf = mi.sf
+        self.env = env
+        self.returns: List[AbstractValue] = []
+
+    # -- statements --------------------------------------------------------
+
+    def run(self, func: ast.FunctionDef) -> AbstractValue:
+        for stmt in walk_local(func):
+            self.stmt(stmt)
+        if not self.returns:
+            return UNKNOWN
+        out = self.returns[0]
+        for r in self.returns[1:]:
+            out = join(out, r)
+        return out
+
+    def stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign):
+            value = self.eval(stmt.value)
+            spec_text = anchor_on_line(self.sf, stmt.lineno)
+            if spec_text is not None:
+                spec = parse_spec(spec_text, self.p.structs)
+                if spec is None:
+                    self.p.report(
+                        self.sf,
+                        stmt.lineno,
+                        f"unparseable anchor `# gc: {spec_text}`",
+                    )
+                else:
+                    value = spec
+            for target in stmt.targets:
+                self.bind(target, value)
+        elif isinstance(stmt, ast.AugAssign):
+            # copy_location: violations triggered inside the synthetic
+            # BinOp report at the statement's line instead of crashing on
+            # a location-less node.
+            value = self.eval(
+                ast.copy_location(
+                    ast.BinOp(
+                        left=stmt.target, op=stmt.op, right=stmt.value
+                    ),
+                    stmt,
+                )
+            )
+            if isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = value
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None and isinstance(stmt.target, ast.Name):
+                self.env[stmt.target.id] = self.eval(stmt.value)
+        elif isinstance(stmt, ast.For):
+            self.bind(stmt.target, self._iter_value(stmt.iter))
+        elif isinstance(stmt, ast.Return):
+            self.returns.append(
+                self.eval(stmt.value) if stmt.value is not None else Static(None)
+            )
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self.eval(stmt.test)
+        elif isinstance(stmt, ast.FunctionDef):
+            # Nested function: analyze with a closure snapshot; expose its
+            # summary for later call sites in this body.
+            fi = FunctionInfo(self.mi.name, stmt)
+            for arg in stmt.args.args + stmt.args.kwonlyargs:
+                spec_text = anchor_on_line(self.sf, arg.lineno)
+                if spec_text is not None:
+                    spec = parse_spec(spec_text, self.p.structs)
+                    if spec is not None:
+                        fi.anchors[arg.arg] = spec
+                elif (
+                    isinstance(arg.annotation, ast.Name)
+                    and arg.annotation.id in self.p.structs
+                ):
+                    fi.anchors[arg.arg] = Struct(arg.annotation.id)
+            closure_env = dict(self.env)
+            for p in fi.params + fi.kwonly:
+                closure_env[p] = fi.anchors.get(p, UNKNOWN)
+            if stmt.args.vararg:
+                closure_env[stmt.args.vararg.arg] = UNKNOWN
+            sub = _FunctionInterp(self.p, self.mi, closure_env)
+            fi.returns = sub.run(stmt)
+            fi.analyzed = True
+            self.env[stmt.name] = _LocalFunc(fi)
+
+    def bind(self, target: ast.expr, value: AbstractValue) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            items: Optional[Sequence[AbstractValue]] = None
+            if isinstance(value, TupleVal) and len(value.items) == len(
+                target.elts
+            ):
+                items = value.items
+            for i, elt in enumerate(target.elts):
+                self.bind(elt, items[i] if items is not None else UNKNOWN)
+        # Subscript/Attribute targets mutate objects we don't track.
+
+    def _iter_value(self, node: ast.expr) -> AbstractValue:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("range", "enumerate")
+        ):
+            return Static()
+        value = self.eval(node)
+        if isinstance(value, TupleVal):
+            out: AbstractValue = value.items[0] if value.items else UNKNOWN
+            for item in value.items[1:]:
+                out = join(out, item)
+            return out
+        return UNKNOWN
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node: ast.expr, parent: Optional[str] = None) -> AbstractValue:
+        if isinstance(node, ast.Constant):
+            return Static(node.value)
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            if node.id in self.mi.constants:
+                return self.mi.constants[node.id]
+            if node.id in self.mi.functions:
+                return _LocalFunc(self.mi.functions[node.id])
+            return UNKNOWN
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node)
+        if isinstance(node, ast.BinOp):
+            return self._binop(node)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                self.eval(v)
+            return Static()
+        if isinstance(node, ast.UnaryOp):
+            operand = self.eval(node.operand, parent=parent)
+            if isinstance(node.op, ast.Not):
+                return Static()
+            if isinstance(operand, Arr):
+                return Arr(operand.dtype, operand.shape)
+            if isinstance(operand, Static):
+                return Static()
+            return UNKNOWN
+        if isinstance(node, ast.Compare):
+            vals = [self.eval(node.left, parent="compare")] + [
+                self.eval(c, parent="compare") for c in node.comparators
+            ]
+            arrs = [v for v in vals if isinstance(v, Arr)]
+            if not arrs:
+                return Static()
+            shape: Optional[Shape] = arrs[0].shape
+            for other in arrs[1:]:
+                shape, ok = broadcast(shape, other.shape)
+                if not ok:
+                    self.p.report(
+                        self.sf,
+                        node.lineno,
+                        "comparison of provably non-broadcastable shapes",
+                    )
+            return Arr(BOOL, shape)
+        if isinstance(node, ast.Call):
+            return self._call(node, parent=parent)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return TupleVal([self.eval(e) for e in node.elts])
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, ast.Starred):
+            self.eval(node.value)
+            return UNKNOWN
+        if isinstance(node, ast.Lambda):
+            return UNKNOWN  # bodies intentionally unevaluated (conservative)
+        if isinstance(node, (ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.GeneratorExp)):
+            return UNKNOWN
+        if isinstance(node, ast.JoinedStr):
+            return Static()
+        return UNKNOWN
+
+    def _attribute(self, node: ast.Attribute) -> AbstractValue:
+        base = self.eval(node.value)
+        if isinstance(base, Struct):
+            si = self.p.structs.get(base.name)
+            if si is None:
+                return UNKNOWN
+            fs = si.fields.get(node.attr)
+            if fs is not None:
+                return fs.value
+            if si.all_static:
+                return Static()  # properties of config structs
+            return UNKNOWN
+        if node.attr in ("shape", "ndim", "size"):
+            return Static()
+        if isinstance(base, Arr) and node.attr == "at":
+            return base  # .at proxy: indexing+update returns the base array
+        if isinstance(node.value, ast.Name):
+            target = self.mi.aliases.get(node.value.id)
+            if target and target in self.p.modules:
+                tm = self.p.modules[target]
+                if node.attr in tm.functions:
+                    return _LocalFunc(tm.functions[node.attr])
+                if node.attr in tm.constants:
+                    return tm.constants[node.attr]
+        return UNKNOWN
+
+    def _binop(self, node: ast.BinOp) -> AbstractValue:
+        left = self.eval(node.left)
+        right = self.eval(node.right)
+        arith = isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv,
+                      ast.Mod, ast.Pow)
+        )
+        if isinstance(left, Arr) and isinstance(right, Arr):
+            self._mix_check(node, left.dtype, right.dtype, arith)
+            shape, ok = broadcast(left.shape, right.shape)
+            if not ok:
+                self.p.report(
+                    self.sf,
+                    node.lineno,
+                    "operands have provably non-broadcastable shapes",
+                )
+            return Arr(promote(left.dtype, right.dtype), shape)
+        if isinstance(left, Arr) or isinstance(right, Arr):
+            arr = left if isinstance(left, Arr) else right
+            other = right if isinstance(left, Arr) else left
+            if isinstance(other, Static):
+                if arr.dtype == BOOL and arith:
+                    self.p.report(
+                        self.sf,
+                        node.lineno,
+                        "arithmetic between a bool array and a Python "
+                        "scalar promotes context-dependently (int32 without "
+                        "x64, int64 with); cast with .astype(jnp.int32) "
+                        "first",
+                    )
+                    return Arr(None, arr.shape)
+                if arr.dtype == INDEX and arith:
+                    self._index_arith(node)
+                    return Arr(None, arr.shape)
+                return Arr(arr.dtype, arr.shape)
+            return UNKNOWN
+        if isinstance(left, Static) and isinstance(right, Static):
+            return _static_binop(left, right, node.op)
+        return UNKNOWN
+
+    def _mix_check(
+        self,
+        node: ast.expr,
+        d1: Optional[str],
+        d2: Optional[str],
+        arith: bool,
+    ) -> None:
+        if INDEX in (d1, d2) and arith:
+            self._index_arith(node)
+            return
+        if widens(d1, d2):
+            self.p.report(
+                self.sf,
+                node.lineno,
+                f"mixing {d1} with {d2} silently widens to "
+                f"{promote(d1, d2)} — cast one side explicitly "
+                "(int32/bool plane contract, kernels.py docstring)",
+            )
+
+    def _index_arith(self, node: ast.expr) -> None:
+        self.p.report(
+            self.sf,
+            node.lineno,
+            "arithmetic on an index-typed value (argsort/argmax result: "
+            "int32 without x64, int64 with); use it only for indexing or "
+            ".astype(jnp.int32) first",
+        )
+
+    def _subscript(self, node: ast.Subscript) -> AbstractValue:
+        base = self.eval(node.value)
+        if isinstance(base, TupleVal):
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, int
+            ):
+                idx = node.slice.value
+                if -len(base.items) <= idx < len(base.items):
+                    return base.items[idx]
+            if isinstance(node.slice, ast.Slice):
+                lo = node.slice.lower
+                hi = node.slice.upper
+                lo_i = lo.value if isinstance(lo, ast.Constant) else None
+                hi_i = hi.value if isinstance(hi, ast.Constant) else None
+                if node.slice.step is None and (
+                    lo_i is None or isinstance(lo_i, int)
+                ) and (hi_i is None or isinstance(hi_i, int)):
+                    return TupleVal(base.items[slice(lo_i, hi_i)])
+            return UNKNOWN
+        if isinstance(base, Static):
+            return Static()
+        if not isinstance(base, Arr):
+            return UNKNOWN
+        elts = (
+            list(node.slice.elts)
+            if isinstance(node.slice, ast.Tuple)
+            else [node.slice]
+        )
+        shape = base.shape
+        dims: Optional[List[Dim]] = None
+        if shape is not None and ELLIPSIS not in shape:
+            dims = list(shape)
+        out: Optional[List[Dim]] = [] if dims is not None else None
+        pos = 0
+        for elt in elts:
+            if isinstance(elt, ast.Constant) and elt.value is None:
+                if out is not None:
+                    out.append(1)
+                continue
+            if isinstance(elt, ast.Constant) and elt.value is Ellipsis:
+                out = None
+                dims = None
+                continue
+            value = self.eval(elt)
+            if isinstance(elt, ast.Slice):
+                if dims is not None and out is not None and pos < len(dims):
+                    full = (
+                        elt.lower is None
+                        and elt.upper is None
+                        and elt.step is None
+                    )
+                    out.append(dims[pos] if full else "?")
+                pos += 1
+                continue
+            if isinstance(value, Arr):
+                # fancy indexing: dtype preserved, shape unknown
+                return Arr(base.dtype, None)
+            # int index: drops a dim
+            if dims is not None and pos >= len(dims):
+                out = None
+                dims = None
+            pos += 1
+        if out is not None and dims is not None:
+            out.extend(dims[pos:])
+            return Arr(base.dtype, tuple(out))
+        return Arr(base.dtype, None)
+
+    # -- calls -------------------------------------------------------------
+
+    def _call(self, node: ast.Call, parent: Optional[str]) -> AbstractValue:
+        func = node.func
+        # method calls on abstract values
+        if isinstance(func, ast.Attribute):
+            if func.attr == "astype":
+                base = self.eval(func.value, parent="astype")
+                dtype = (
+                    _dtype_of_node(node.args[0]) if node.args else None
+                )
+                shape = base.shape if isinstance(base, Arr) else None
+                return Arr(dtype, shape)
+            if func.attr in _REDUCTIONS_ADDITIVE:
+                base = self.eval(func.value)
+                # Only a KNOWN jnp array triggers the widening check: an
+                # Unknown receiver may be host numpy (driver/simref), and
+                # Unknown must never produce a violation.
+                if isinstance(base, Arr):
+                    return self._reduction(node, base, parent)
+            if func.attr in ("set", "add", "max", "min", "multiply") and (
+                isinstance(func.value, ast.Subscript)
+                and isinstance(func.value.value, ast.Attribute)
+                and func.value.value.attr == "at"
+            ):
+                # .at[...]<op>(v) ONLY: the proxy already evaluated to the
+                # base array.  Plain .max()/.min() are reductions, below.
+                base = self.eval(func.value)
+                for a in node.args:
+                    self.eval(a)
+                if isinstance(base, Arr):
+                    return Arr(base.dtype, base.shape)
+                return UNKNOWN
+            if func.attr in ("max", "min", "any", "all"):
+                base = self.eval(func.value)
+                if isinstance(base, Arr):
+                    shape, axis, keep = self._axis_of(node, base)
+                    if node.args:
+                        # positional axis: understood only as a literal int
+                        if len(node.args) == 1 and isinstance(
+                            node.args[0], ast.Constant
+                        ) and isinstance(node.args[0].value, int):
+                            axis = node.args[0].value
+                        else:
+                            shape = None
+                    dtype = BOOL if func.attr in ("any", "all") else base.dtype
+                    return Arr(dtype, reduce_shape(shape, axis, keep))
+            if isinstance(func.value, ast.Name) and func.value.id == "jnp":
+                return self._jnp_call(node, func.attr, parent)
+            resolved = self.p.resolve_call(self.mi, func)
+            if resolved is not None:
+                return self._known_call(node, resolved)
+            jax_val = self._jax_call(node, func)
+            if jax_val is not None:
+                return jax_val
+            if func.attr == "_replace":
+                base = self.eval(func.value)
+                if isinstance(base, Struct):
+                    self._check_struct_fields(node, base.name, node.keywords)
+                    return base
+            for a in node.args:
+                self.eval(a)
+            for kw in node.keywords:
+                self.eval(kw.value)
+            return UNKNOWN
+        # plain-name calls
+        if isinstance(func, ast.Name):
+            target = self.env.get(func.id)
+            if isinstance(target, _LocalFunc):
+                return self._known_call(node, target.fi)
+            resolved = self.p.resolve_call(self.mi, func)
+            if resolved is not None:
+                return self._known_call(node, resolved)
+            if func.id in self.p.structs:
+                self._check_struct_fields(node, func.id, node.keywords)
+                for a in node.args:
+                    self.eval(a)
+                return Struct(func.id)
+            if func.id in ("len", "min", "max", "abs", "int", "float", "bool"):
+                for a in node.args:
+                    self.eval(a)
+                return Static()
+        for a in node.args:
+            self.eval(a)
+        for kw in node.keywords:
+            self.eval(kw.value)
+        return UNKNOWN
+
+    def _reduction(
+        self, node: ast.Call, operand: AbstractValue, parent: Optional[str]
+    ) -> AbstractValue:
+        """jnp.sum/jnp.prod (and the method forms): the x64-widening rule."""
+        dtype_kw = None
+        axis_val: Optional[int] = None
+        keepdims = False
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dtype_kw = _dtype_of_node(kw.value)
+            elif kw.arg == "axis":
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, int
+                ):
+                    axis_val = kw.value.value
+                else:
+                    axis_val = None
+            elif kw.arg == "keepdims":
+                keepdims = (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                )
+        has_axis = any(kw.arg == "axis" for kw in node.keywords)
+        shape = operand.shape if isinstance(operand, Arr) else None
+        out_shape = reduce_shape(shape, axis_val if has_axis else None, keepdims)
+        if dtype_kw is not None:
+            return Arr(dtype_kw, out_shape)
+        op_dtype = operand.dtype if isinstance(operand, Arr) else None
+        if op_dtype in ("float32", "float64"):
+            return Arr(op_dtype, out_shape)
+        if parent not in ("astype", "compare"):
+            self.p.report(
+                self.sf,
+                node.lineno,
+                "additive reduction without an explicit dtype widens "
+                "int32/bool operands to int64 under x64 (and only there — "
+                "the non-x64 suite can't see it); pass dtype=jnp.int32 or "
+                "cast the result with .astype",
+            )
+        return Arr(None, out_shape)
+
+    def _jnp_call(
+        self, node: ast.Call, attr: str, parent: Optional[str]
+    ) -> AbstractValue:
+        args = node.args
+        if attr in _REDUCTIONS_ADDITIVE:
+            operand = self.eval(args[0]) if args else UNKNOWN
+            return self._reduction(node, operand, parent)
+        if attr in _REDUCTIONS_EXTREME:
+            operand = self.eval(args[0]) if args else UNKNOWN
+            shape, axis, keep = self._axis_of(node, operand)
+            return Arr(
+                operand.dtype if isinstance(operand, Arr) else None,
+                reduce_shape(shape, axis, keep),
+            )
+        if attr in _REDUCTIONS_BOOL:
+            operand = self.eval(args[0]) if args else UNKNOWN
+            shape, axis, keep = self._axis_of(node, operand)
+            return Arr(BOOL, reduce_shape(shape, axis, keep))
+        if attr in _REDUCTIONS_INDEX:
+            operand = self.eval(args[0]) if args else UNKNOWN
+            shape, axis, keep = self._axis_of(node, operand)
+            return Arr(INDEX, reduce_shape(shape, axis, keep))
+        if attr == "argsort":
+            operand = self.eval(args[0]) if args else UNKNOWN
+            for kw in node.keywords:
+                self.eval(kw.value)
+            return Arr(
+                INDEX, operand.shape if isinstance(operand, Arr) else None
+            )
+        if attr == "where" and len(args) == 3:
+            cond = self.eval(args[0])
+            a = self.eval(args[1])
+            b = self.eval(args[2])
+            return self._ternary(node, cond, a, b)
+        if attr in _ELEMENTWISE_BINARY and len(args) >= 2:
+            a = self.eval(args[0])
+            b = self.eval(args[1])
+            if attr in ("logical_and", "logical_or"):
+                shape, _ = broadcast(
+                    a.shape if isinstance(a, Arr) else None,
+                    b.shape if isinstance(b, Arr) else None,
+                )
+                return Arr(BOOL, shape)
+            if isinstance(a, Arr) and isinstance(b, Arr):
+                self._mix_check(node, a.dtype, b.dtype, arith=True)
+                shape, ok = broadcast(a.shape, b.shape)
+                if not ok:
+                    self.p.report(
+                        self.sf,
+                        node.lineno,
+                        f"jnp.{attr} operands have provably "
+                        "non-broadcastable shapes",
+                    )
+                return Arr(promote(a.dtype, b.dtype), shape)
+            if isinstance(a, Arr) or isinstance(b, Arr):
+                arr = a if isinstance(a, Arr) else b
+                return Arr(arr.dtype, arr.shape)
+            return UNKNOWN
+        if attr == "stack" or attr == "concatenate":
+            elts = self.eval(args[0]) if args else UNKNOWN
+            if isinstance(elts, TupleVal):
+                dtype: Optional[str] = None
+                shapes: List[Optional[Shape]] = []
+                for item in elts.items:
+                    if isinstance(item, Arr):
+                        if dtype is None:
+                            dtype = item.dtype
+                        elif item.dtype is not None and item.dtype != dtype:
+                            self._mix_check(node, dtype, item.dtype, True)
+                            dtype = promote(dtype, item.dtype)
+                        shapes.append(item.shape)
+                    else:
+                        dtype = dtype if isinstance(item, Static) else None
+                        shapes.append(None)
+                if attr == "stack" and shapes and all(
+                    s is not None and s == shapes[0] and ELLIPSIS not in s
+                    for s in shapes
+                ) and not node.keywords:
+                    first = shapes[0]
+                    assert first is not None
+                    return Arr(dtype, (len(shapes),) + first)
+                return Arr(dtype, None)
+            return UNKNOWN
+        if attr in _CTOR_DTYPE_POS:
+            return self._ctor(node, attr)
+        if attr in _DTYPE_CASTS or attr == "bool_":
+            operand = self.eval(args[0]) if args else None
+            dtype = BOOL if attr == "bool_" else attr
+            if isinstance(operand, Arr):
+                return Arr(dtype, operand.shape)
+            return Arr(dtype, ())
+        if attr in ("zeros_like", "ones_like", "full_like"):
+            operand = self.eval(args[0]) if args else UNKNOWN
+            if isinstance(operand, Arr):
+                return Arr(operand.dtype, operand.shape)
+            return UNKNOWN
+        if attr == "take_along_axis":
+            operand = self.eval(args[0]) if args else UNKNOWN
+            for a in args[1:]:
+                self.eval(a)
+            return Arr(
+                operand.dtype if isinstance(operand, Arr) else None, None
+            )
+        if attr in _DTYPE_PRESERVING_UNARY:
+            operand = self.eval(args[0]) if args else UNKNOWN
+            for a in args[1:]:
+                self.eval(a)
+            if isinstance(operand, Arr):
+                preserve_shape = attr in ("sort", "clip", "abs", "negative", "flip")
+                return Arr(
+                    operand.dtype, operand.shape if preserve_shape else None
+                )
+            return UNKNOWN
+        for a in args:
+            self.eval(a)
+        for kw in node.keywords:
+            self.eval(kw.value)
+        return UNKNOWN
+
+    def _axis_of(
+        self, node: ast.Call, operand: AbstractValue
+    ) -> Tuple[Optional[Shape], Optional[int], bool]:
+        axis: Optional[int] = None
+        keep = False
+        has_axis = False
+        for kw in node.keywords:
+            if kw.arg == "axis":
+                has_axis = True
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, int
+                ):
+                    axis = kw.value.value
+            elif kw.arg == "keepdims":
+                keep = (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                )
+        shape = operand.shape if isinstance(operand, Arr) else None
+        if has_axis and axis is None:
+            return None, None, keep  # dynamic axis: shape unknown
+        return shape, axis if has_axis else None, keep
+
+    def _ternary(
+        self,
+        node: ast.Call,
+        cond: AbstractValue,
+        a: AbstractValue,
+        b: AbstractValue,
+    ) -> AbstractValue:
+        if isinstance(a, Arr) and isinstance(b, Arr):
+            self._mix_check(node, a.dtype, b.dtype, arith=True)
+            shape, ok = broadcast(a.shape, b.shape)
+            if isinstance(cond, Arr):
+                shape, ok2 = broadcast(shape, cond.shape)
+                ok = ok and ok2
+            if not ok:
+                self.p.report(
+                    self.sf,
+                    node.lineno,
+                    "jnp.where branches have provably non-broadcastable "
+                    "shapes",
+                )
+            return Arr(promote(a.dtype, b.dtype), shape)
+        arr = a if isinstance(a, Arr) else (b if isinstance(b, Arr) else None)
+        other = b if arr is a else a
+        if arr is not None and isinstance(other, Static):
+            # weak Python scalar adopts the array branch's dtype
+            shape = arr.shape
+            if isinstance(cond, Arr):
+                shape, _ = broadcast(shape, cond.shape)
+            return Arr(arr.dtype, shape)
+        if isinstance(cond, Arr):
+            return Arr(None, None)
+        return UNKNOWN
+
+    def _ctor(self, node: ast.Call, attr: str) -> AbstractValue:
+        dtype: Optional[str] = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dtype = _dtype_of_node(kw.value)
+        pos = _CTOR_DTYPE_POS[attr]
+        if dtype is None and len(node.args) > pos:
+            dtype = _dtype_of_node(node.args[pos])
+        shape: Optional[Shape] = None
+        if attr in ("zeros", "ones", "full") and node.args:
+            shape = self._static_shape(node.args[0])
+        elif attr == "arange":
+            shape = ("?",)
+        elif attr in ("asarray", "array") and node.args:
+            v = self.eval(node.args[0])
+            if isinstance(v, Static) and isinstance(v.value, tuple):
+                shape = (len(v.value),)
+            elif isinstance(v, TupleVal):
+                shape = (len(v.items),)
+            elif isinstance(v, Arr):
+                shape = v.shape
+        for a in node.args:
+            self.eval(a)
+        return Arr(dtype, shape)
+
+    def _static_shape(self, node: ast.expr) -> Optional[Shape]:
+        """Shape tuple literal -> symbolic dims (ints kept, static names
+        become their symbol, anything else an unknown dim)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            v = self.eval(node)
+            if isinstance(v, Static) and isinstance(v.value, tuple) and all(
+                isinstance(d, int) for d in v.value
+            ):
+                return tuple(v.value)
+            return None
+        dims: List[Dim] = []
+        for elt in node.elts:
+            v = self.eval(elt)
+            if isinstance(v, Static) and isinstance(v.value, int):
+                dims.append(v.value)
+            elif isinstance(elt, ast.Name):
+                dims.append(elt.id)
+            elif (
+                isinstance(elt, ast.Attribute)
+                and isinstance(v, Static)
+            ):
+                dims.append(elt.attr)
+            else:
+                dims.append("?")
+        return tuple(dims)
+
+    def _jax_call(
+        self, node: ast.Call, func: ast.Attribute
+    ) -> Optional[AbstractValue]:
+        name = _dotted(func)
+        if name is None:
+            return None
+        if name == "jax.lax.top_k":
+            operand = self.eval(node.args[0]) if node.args else UNKNOWN
+            return TupleVal(
+                [
+                    Arr(
+                        operand.dtype if isinstance(operand, Arr) else None,
+                        None,
+                    ),
+                    Arr(INT32, None),
+                ]
+            )
+        if name == "jax.lax.fori_loop" and len(node.args) == 4:
+            self.eval(node.args[0])
+            self.eval(node.args[1])
+            return self.eval(node.args[3])
+        if name.startswith(("jax.", "pl.", "pltpu.", "functools.", "np.")):
+            for a in node.args:
+                self.eval(a)
+            for kw in node.keywords:
+                self.eval(kw.value)
+            return UNKNOWN
+        return None
+
+    def _known_call(
+        self, node: ast.Call, fi: FunctionInfo
+    ) -> AbstractValue:
+        """Call to an analyzed function: bind args, check them against the
+        callee's anchors, return its summary."""
+        target_mi = self.p.modules.get(fi.module)
+        if target_mi is not None and not fi.analyzed:
+            self.p.analyze_function(target_mi, fi)
+        bindings: List[Tuple[str, ast.expr]] = []
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                self.eval(a.value)
+                break  # positional binding unknowable past a *splat
+            if i < len(fi.params):
+                bindings.append((fi.params[i], a))
+            else:
+                self.eval(a)
+        for kw in node.keywords:
+            if kw.arg is None:
+                self.eval(kw.value)
+            elif kw.arg in fi.params or kw.arg in fi.kwonly:
+                bindings.append((kw.arg, kw.value))
+            else:
+                self.eval(kw.value)
+        for pname, expr in bindings:
+            value = self.eval(expr)
+            spec = fi.anchors.get(pname)
+            if not isinstance(spec, Arr) or not isinstance(value, Arr):
+                continue
+            if (
+                spec.dtype is not None
+                and value.dtype is not None
+                and value.dtype != spec.dtype
+            ):
+                self.p.report(
+                    self.sf,
+                    expr.lineno,
+                    f"argument `{pname}` of {fi.name}() is {value.dtype} "
+                    f"but the callee's anchor declares {spec.dtype} "
+                    "(dtype mixing across a call boundary)",
+                )
+                continue
+            srank = spec_rank(spec.shape)
+            vrank = spec_rank(value.shape)
+            if srank is not None and vrank is not None and srank != vrank:
+                self.p.report(
+                    self.sf,
+                    expr.lineno,
+                    f"argument `{pname}` of {fi.name}() has rank {vrank} "
+                    f"but the callee's anchor declares rank {srank} "
+                    "(shape rank drift across a call boundary)",
+                )
+        return fi.returns
+
+    def _check_struct_fields(
+        self,
+        node: ast.Call,
+        struct_name: str,
+        keywords: Sequence[ast.keyword],
+    ) -> None:
+        si = self.p.structs.get(struct_name)
+        if si is None:
+            return
+        for kw in keywords:
+            if kw.arg is None:
+                self.eval(kw.value)
+                continue
+            value = self.eval(kw.value)
+            fs = si.fields.get(kw.arg)
+            if fs is None or not isinstance(fs.value, Arr):
+                continue
+            if (
+                isinstance(value, Arr)
+                and value.dtype is not None
+                and fs.value.dtype is not None
+                and value.dtype != fs.value.dtype
+            ):
+                self.p.report(
+                    self.sf,
+                    kw.value.lineno,
+                    f"field `{struct_name}.{kw.arg}` is declared "
+                    f"{fs.value.dtype} but gets a {value.dtype} value",
+                )
+
+
+class _LocalFunc(AbstractValue):
+    """A reference to a known (module-level or nested) function."""
+
+    __slots__ = ("fi",)
+
+    def __init__(self, fi: FunctionInfo):
+        self.fi = fi
+
+
+def _static_binop(
+    left: Static, right: Static, op: ast.operator
+) -> AbstractValue:
+    lv, rv = left.value, right.value
+    if isinstance(lv, int) and isinstance(rv, int):
+        try:
+            if isinstance(op, ast.Add):
+                return Static(lv + rv)
+            if isinstance(op, ast.Sub):
+                return Static(lv - rv)
+            if isinstance(op, ast.Mult):
+                return Static(lv * rv)
+            if isinstance(op, ast.FloorDiv):
+                return Static(lv // rv)
+            if isinstance(op, ast.Mod):
+                return Static(lv % rv)
+            if isinstance(op, ast.LShift):
+                return Static(lv << rv)
+            if isinstance(op, ast.RShift):
+                return Static(lv >> rv)
+            if isinstance(op, ast.Pow):
+                return Static(lv**rv)
+        except (ValueError, ZeroDivisionError, OverflowError):
+            return Static()
+    return Static()
+
+
+def _dotted(node: ast.Attribute) -> Optional[str]:
+    parts: List[str] = []
+    cur: ast.expr = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def build_program(
+    files: Sequence[SourceFile],
+) -> Program:
+    """Assemble the engine's Program from whichever engine modules appear
+    in the scanned file set (fixtures may supply a subset)."""
+    program = Program()
+    by_suffix = {suffix: name for name, suffix in ENGINE_MODULES}
+    found: Dict[str, SourceFile] = {}
+    for sf in files:
+        if not sf.is_python:
+            continue
+        for suffix, name in by_suffix.items():
+            if sf.norm().endswith(suffix):
+                found[name] = sf
+    for name, _ in ENGINE_MODULES:
+        if name in found:
+            program.add_module(name, found[name])
+    return program

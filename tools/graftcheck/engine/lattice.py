@@ -1,0 +1,336 @@
+"""Abstract-value lattice for the graftcheck engine (docs/STATIC_ANALYSIS.md).
+
+The engine reasons about four kinds of value:
+
+  * ``Arr(dtype, shape)`` — a jnp array.  ``dtype`` is one of DTYPES (or
+    None = unknown); ``shape`` is a tuple of dims — a Python int, a symbol
+    string ("P", "G"), ``DIM_ANY`` for a single unknown dim, or a leading
+    ``ELLIPSIS`` for "any rank prefix" — or None for unknown rank.
+  * ``Static(value)`` — a compile-time Python value (shape/int/bool/config
+    field); never traced, safe to branch on.
+  * ``Struct(name)`` — an instance of a registered NamedTuple-like struct
+    (SimState, HealthState, SimConfig); attribute reads produce the
+    registered field values.
+  * ``Unknown`` — anything the interpreter cannot prove.  Unknown never
+    produces a violation: the engine is conservative by construction.
+
+Dtype promotion follows jax.numpy under ``JAX_ENABLE_X64=1`` — the HAZARD
+configuration.  Without x64 every int result truncates to int32, which is
+why the divergences this lattice flags are silent: the tier-1 suite (no
+x64) cannot see them, an x64 consumer gets different plane dtypes.  The
+table below was generated against jax 0.4.37 (see the probes quoted in
+docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple, Union
+
+# --- dtypes -----------------------------------------------------------------
+
+BOOL = "bool"
+INT8 = "int8"
+UINT8 = "uint8"
+INT16 = "int16"
+UINT16 = "uint16"
+INT32 = "int32"
+UINT32 = "uint32"
+INT64 = "int64"
+UINT64 = "uint64"
+FLOAT32 = "float32"
+FLOAT64 = "float64"
+# Pseudo-dtype for index-producing ops (argsort/argmax): int32 without x64,
+# int64 with — legal as a gather/scatter index, a hazard in plane math.
+INDEX = "index"
+
+DTYPES: FrozenSet[str] = frozenset(
+    {
+        BOOL, INT8, UINT8, INT16, UINT16, INT32, UINT32, INT64, UINT64,
+        FLOAT32, FLOAT64, INDEX,
+    }
+)
+
+_SIGNED = {INT8: 8, INT16: 16, INT32: 32, INT64: 64}
+_UNSIGNED = {UINT8: 8, UINT16: 16, UINT32: 32, UINT64: 64}
+_FLOATS = {FLOAT32: 32, FLOAT64: 64}
+
+# Dtypes wider than the device-plane contract (int32/uint32/bool).
+WIDE = frozenset({INT64, UINT64, FLOAT64})
+
+
+def _signed_of_width(bits: int) -> str:
+    for name, w in _SIGNED.items():
+        if w == bits:
+            return name
+    return INT64
+
+
+def promote(d1: Optional[str], d2: Optional[str]) -> Optional[str]:
+    """jax.numpy array-array promotion under x64 for the dtypes we model.
+
+    Returns None when either side is unknown (no conclusion, no flag)."""
+    if d1 is None or d2 is None:
+        return None
+    if d1 == d2:
+        return d1
+    if INDEX in (d1, d2):
+        # Index arithmetic is context-dependent (int32 vs int64); the
+        # caller flags it as a hazard before asking for the result.
+        return INT64
+    if d1 == BOOL:
+        return d2
+    if d2 == BOOL:
+        return d1
+    if d1 in _FLOATS or d2 in _FLOATS:
+        if d1 in _FLOATS and d2 in _FLOATS:
+            return FLOAT64 if FLOAT64 in (d1, d2) else FLOAT32
+        return d1 if d1 in _FLOATS else d2
+    s1, s2 = d1 in _SIGNED, d2 in _SIGNED
+    w1 = _SIGNED.get(d1) or _UNSIGNED.get(d1) or 64
+    w2 = _SIGNED.get(d2) or _UNSIGNED.get(d2) or 64
+    if s1 == s2:
+        return d1 if w1 >= w2 else d2
+    # signed x unsigned: the signed type wins if strictly wider, else the
+    # next wider signed type (int32 x uint32 -> int64 — the silent widening
+    # GC007 exists to catch).
+    signed_w = w1 if s1 else w2
+    unsigned_w = w2 if s1 else w1
+    if signed_w > unsigned_w:
+        return _signed_of_width(signed_w)
+    return _signed_of_width(min(64, unsigned_w * 2))
+
+
+def widens(d1: Optional[str], d2: Optional[str]) -> bool:
+    """True when combining two KNOWN dtypes produces a dtype strictly wider
+    than both operands — the silent-widening hazard (int32 x uint32 ->
+    int64).  Unknown operands never flag."""
+    if d1 is None or d2 is None:
+        return False
+    out = promote(d1, d2)
+    return out is not None and out not in (d1, d2)
+
+
+# --- shapes -----------------------------------------------------------------
+
+ELLIPSIS = "..."
+DIM_ANY = "?"
+
+Dim = Union[int, str]
+Shape = Tuple[Dim, ...]
+
+
+def _dim_compat(a: Dim, b: Dim) -> bool:
+    """Can dims a and b broadcast?  Only a pair of UNEQUAL int literals
+    (neither 1) is provably incompatible; symbols are never provably
+    unequal (P could equal G)."""
+    if a == 1 or b == 1 or a == DIM_ANY or b == DIM_ANY:
+        return True
+    if isinstance(a, int) and isinstance(b, int):
+        return a == b
+    return True
+
+
+def _dim_merge(a: Dim, b: Dim) -> Dim:
+    if a == b:
+        return a
+    if a == 1:
+        return b
+    if b == 1:
+        return a
+    if a == DIM_ANY:
+        return b
+    if b == DIM_ANY:
+        return a
+    return DIM_ANY
+
+
+def broadcast(
+    s1: Optional[Shape], s2: Optional[Shape]
+) -> Tuple[Optional[Shape], bool]:
+    """Numpy-style broadcast of two shapes.
+
+    Returns (result_shape_or_None, ok).  ok is False only on a PROVABLE
+    incompatibility (two unequal int dims, neither 1, at the same aligned
+    position, with no ellipsis in play)."""
+    if s1 is None or s2 is None or ELLIPSIS in (s1 or ()) or ELLIPSIS in (s2 or ()):
+        return None, True
+    out: List[Dim] = []
+    r1, r2 = list(s1), list(s2)
+    n = max(len(r1), len(r2))
+    for i in range(1, n + 1):
+        a: Dim = r1[-i] if i <= len(r1) else 1
+        b: Dim = r2[-i] if i <= len(r2) else 1
+        if not _dim_compat(a, b):
+            return None, False
+        out.append(_dim_merge(a, b))
+    return tuple(reversed(out)), True
+
+
+def reduce_shape(
+    shape: Optional[Shape], axis: Optional[int], keepdims: bool
+) -> Optional[Shape]:
+    """Shape after a reduction along ``axis`` (None = full reduce)."""
+    if shape is None or ELLIPSIS in shape:
+        return None
+    if axis is None:
+        return tuple(1 for _ in shape) if keepdims else ()
+    try:
+        idx = axis if axis >= 0 else len(shape) + axis
+        if not 0 <= idx < len(shape):
+            return None
+    except TypeError:
+        return None
+    if keepdims:
+        return tuple(1 if i == idx else d for i, d in enumerate(shape))
+    return tuple(d for i, d in enumerate(shape) if i != idx)
+
+
+# --- abstract values --------------------------------------------------------
+
+
+class AbstractValue:
+    """Base marker; concrete kinds below."""
+
+    __slots__ = ()
+
+
+class Unknown(AbstractValue):
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "Unknown"
+
+
+UNKNOWN = Unknown()
+
+
+class Arr(AbstractValue):
+    """A jnp array of (possibly unknown) dtype and shape."""
+
+    __slots__ = ("dtype", "shape")
+
+    def __init__(
+        self, dtype: Optional[str] = None, shape: Optional[Shape] = None
+    ):
+        self.dtype = dtype
+        self.shape = shape
+
+    def __repr__(self) -> str:
+        dims = "?" if self.shape is None else ", ".join(str(d) for d in self.shape)
+        return f"Arr[{self.dtype or '?'}, ({dims})]"
+
+
+class Static(AbstractValue):
+    """A compile-time Python value; ``value`` is kept when concretely known
+    (ints for shape math), else None."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: object = None):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"Static({self.value!r})"
+
+
+class Struct(AbstractValue):
+    """An instance of a registered struct (SimState/HealthState/SimConfig)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"Struct({self.name})"
+
+
+class TupleVal(AbstractValue):
+    """A Python tuple/list of abstract values (for unpacking and returns)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Sequence[AbstractValue]):
+        self.items = tuple(items)
+
+    def __repr__(self) -> str:
+        return f"TupleVal{self.items!r}"
+
+
+def join(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    """Least upper bound for control-flow merges (IfExp, multiple returns)."""
+    if isinstance(a, Unknown) or isinstance(b, Unknown):
+        return UNKNOWN
+    if isinstance(a, Arr) and isinstance(b, Arr):
+        dtype = a.dtype if a.dtype == b.dtype else None
+        shape = a.shape if a.shape == b.shape else None
+        return Arr(dtype, shape)
+    if isinstance(a, Static) and isinstance(b, Static):
+        return Static(a.value if a.value == b.value else None)
+    if isinstance(a, Struct) and isinstance(b, Struct) and a.name == b.name:
+        return a
+    if (
+        isinstance(a, TupleVal)
+        and isinstance(b, TupleVal)
+        and len(a.items) == len(b.items)
+    ):
+        return TupleVal([join(x, y) for x, y in zip(a.items, b.items)])
+    return UNKNOWN
+
+
+# --- anchor-spec parsing ----------------------------------------------------
+#
+#   # gc: int32[P, G]        array anchor (dims: symbols or ints; [] scalar)
+#   # gc: bool[..., P]       any rank prefix
+#   # gc: int32[...]         any rank at all
+#   # gc: static             compile-time Python value
+#   # gc: any                explicitly unknown (silences nothing, documents)
+#   # gc: SimState           registered struct instance
+
+
+def parse_spec(text: str, structs: Dict[str, object]) -> Optional[AbstractValue]:
+    """Parse one anchor spec; None when the text is not a recognized spec
+    (the caller treats that as a hard error — a typo'd anchor must not
+    silently weaken the analysis)."""
+    s = text.strip()
+    if not s:
+        return None
+    if s == "static":
+        return Static()
+    if s == "any":
+        return UNKNOWN
+    if s in structs:
+        return Struct(s)
+    if "[" in s and s.endswith("]"):
+        dtype, _, dims_s = s.partition("[")
+        dtype = dtype.strip()
+        if dtype not in DTYPES:
+            return None
+        body = dims_s[:-1].strip()
+        if not body:
+            return Arr(dtype, ())
+        dims: List[Dim] = []
+        for part in body.split(","):
+            p = part.strip()
+            if p == ELLIPSIS:
+                dims.append(ELLIPSIS)
+            elif p == DIM_ANY:
+                dims.append(DIM_ANY)
+            elif p.lstrip("-").isdigit():
+                dims.append(int(p))
+            elif p.isidentifier():
+                dims.append(p)
+            else:
+                return None
+        return Arr(dtype, tuple(dims))
+    if s in DTYPES:
+        # bare dtype = any-rank array of that dtype
+        return Arr(s, None)
+    return None
+
+
+def spec_rank(shape: Optional[Shape]) -> Optional[int]:
+    """Fixed rank of a spec shape, or None when ellipsis/unknown."""
+    if shape is None or ELLIPSIS in shape:
+        return None
+    return len(shape)

@@ -1,0 +1,421 @@
+"""GC008 plane-overflow bounds: prove the int32 device planes cannot wrap.
+
+Every device-resident int32 accumulator is listed in the registry below
+with its per-round growth bound and its drain/reset story.  The rule then
+verifies — against the AST of kernels.py and sim.py, whichever are in the
+scanned set — that the code still matches the registered model:
+
+  * every ``CTR_*`` / ``HP_*`` plane constant in kernels.py is registered
+    (a NEW plane must be added here, with a derived bound, before it
+    ships), and the ``N_COUNTERS`` / ``N_HEALTH_PLANES`` totals agree;
+  * each health plane's per-round additive growth in
+    ``kernels.update_health`` is provably <= its registered bound (1), so
+    the wrap horizon is >= 2**31 rounds — the same order at which the
+    int32 commit plane itself would overflow, i.e. out of model (see
+    docs/STATIC_ANALYSIS.md for the per-plane derivation);
+  * the counter plane's drain cadence in ``sim.ClusterSim`` still
+    satisfies  window_rounds * BUDGET_PER_GROUP * n_groups <= 2**31:
+    the ``_drain_cap`` expression must keep the shape
+    ``max(1, min(self._DRAIN_MAX, (1 << S) // (K * cfg.n_groups)))``
+    with S <= 31 and K >= BUDGET_PER_GROUP, and the negative-value wrap
+    backstop in ``_drain_counters`` must survive.
+
+The growth bounds that are DECLARED rather than AST-derived (term_bump
+<= 1 per round) carry their derivation in docs/STATIC_ANALYSIS.md; the
+registry pins them so a cadence or fold change fails the build instead of
+silently stretching a bound.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from ..core import SourceFile, Violation
+
+GC008 = "GC008"
+GC008_SLUG = "plane-overflow"
+
+# Declared per-round per-counter event budget: the `256` in ClusterSim's
+# _drain_cap expression.  events/window <= window * BUDGET_PER_GROUP * G.
+BUDGET_PER_GROUP = 256
+# int32 wrap exponent: windows must keep total events <= 2**31.
+WRAP_SHIFT = 31
+
+# Registered counter plane rows (kernels.CTR_*).
+COUNTER_PLANES: Set[str] = {
+    "CTR_CAMPAIGNS",
+    "CTR_HEARTBEATS",
+    "CTR_ELECTIONS_WON",
+    "CTR_COMMIT_ENTRIES",
+}
+
+# Registered health plane rows (kernels.HP_*) -> max additive growth per
+# round.  All four are +1/round (resets only shrink), giving a wrap
+# horizon of 2**31 rounds — out of model, like the commit plane itself.
+HEALTH_PLANES: Dict[str, int] = {
+    "HP_LEADERLESS": 1,
+    "HP_SINCE_COMMIT": 1,
+    "HP_TERM_BUMPS": 1,
+    "HP_VOTE_SPLITS": 1,
+}
+
+# Names inside update_health whose values are DECLARED bounded (<= bound)
+# with the derivation documented in docs/STATIC_ANALYSIS.md rather than
+# proven from this AST.  term_bump: a group's max term grows by at most 1
+# per round (each campaigner adds exactly 1 to its own term and every bump
+# target adopts an existing campaigner's term).
+DECLARED_BOUNDED: Dict[str, int] = {"term_bump": 1}
+
+
+def _v(sf: SourceFile, lineno: int, message: str) -> Violation:
+    return Violation(sf.display_path, lineno, GC008, GC008_SLUG, message)
+
+
+# --- kernels.py side --------------------------------------------------------
+
+
+def check_kernels(sf: SourceFile) -> Iterator[Violation]:
+    tree = sf.ast_tree
+    seen_ctr: Dict[str, int] = {}
+    seen_hp: Dict[str, int] = {}
+    n_counters: Optional[int] = None
+    n_health: Optional[int] = None
+    update_health: Optional[ast.FunctionDef] = None
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id.startswith("CTR_"):
+                    seen_ctr[t.id] = node.lineno
+                elif t.id.startswith("HP_"):
+                    seen_hp[t.id] = node.lineno
+                elif t.id == "N_COUNTERS" and isinstance(
+                    node.value.value, int
+                ):
+                    n_counters = node.value.value
+                elif t.id == "N_HEALTH_PLANES" and isinstance(
+                    node.value.value, int
+                ):
+                    n_health = node.value.value
+        elif isinstance(node, ast.FunctionDef) and node.name == "update_health":
+            update_health = node
+
+    for name, lineno in seen_ctr.items():
+        if name not in COUNTER_PLANES:
+            yield _v(
+                sf,
+                lineno,
+                f"counter plane `{name}` is not in the GC008 registry "
+                "(tools/graftcheck/engine/overflow.py); derive its wrap "
+                "bound and register it (docs/STATIC_ANALYSIS.md)",
+            )
+    for name, lineno in seen_hp.items():
+        if name not in HEALTH_PLANES:
+            yield _v(
+                sf,
+                lineno,
+                f"health plane `{name}` is not in the GC008 registry "
+                "(tools/graftcheck/engine/overflow.py); derive its wrap "
+                "bound and register it (docs/STATIC_ANALYSIS.md)",
+            )
+    if n_counters is not None and seen_ctr and n_counters != len(seen_ctr):
+        yield _v(
+            sf,
+            1,
+            f"N_COUNTERS == {n_counters} but {len(seen_ctr)} CTR_* rows are "
+            "defined; the registry and the plane stack disagree",
+        )
+    if n_health is not None and seen_hp and n_health != len(seen_hp):
+        yield _v(
+            sf,
+            1,
+            f"N_HEALTH_PLANES == {n_health} but {len(seen_hp)} HP_* rows "
+            "are defined; the registry and the plane stack disagree",
+        )
+    if update_health is not None:
+        yield from _check_update_health(sf, update_health)
+
+
+def _check_update_health(
+    sf: SourceFile, func: ast.FunctionDef
+) -> Iterator[Violation]:
+    """Bound each plane row's additive growth in update_health."""
+    # Map assigned name -> (plane row referenced, growth bound or None).
+    param_names = {a.arg for a in func.args.args}
+    for stmt in ast.walk(func):
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        rows = _plane_rows(stmt.value)
+        if not rows:
+            continue
+        row = rows[0]
+        bound = HEALTH_PLANES.get(row)
+        if bound is None:
+            continue  # unregistered row already reported above
+        growth = _growth_bound(stmt.value, row, param_names)
+        if growth is None:
+            yield _v(
+                sf,
+                stmt.lineno,
+                f"cannot prove a per-round growth bound for plane `{row}` "
+                "in update_health — the fold no longer matches a "
+                "reset/where/+increment shape the analysis understands; "
+                "re-derive the wrap bound and update the GC008 registry",
+            )
+        elif growth > bound:
+            yield _v(
+                sf,
+                stmt.lineno,
+                f"plane `{row}` grows by up to {growth} per round but the "
+                f"GC008 registry bounds it at {bound}; the 2**31-round "
+                "wrap horizon no longer holds — re-derive and update the "
+                "registry (docs/STATIC_ANALYSIS.md)",
+            )
+
+
+def _plane_rows(node: ast.expr) -> List[str]:
+    """CTR_*/HP_* names used as subscripts of `planes` in an expression."""
+    out: List[str] = []
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Subscript)
+            and isinstance(sub.slice, ast.Name)
+            and (
+                sub.slice.id.startswith("HP_")
+                or sub.slice.id.startswith("CTR_")
+            )
+        ):
+            out.append(sub.slice.id)
+    return out
+
+
+def _growth_bound(
+    node: ast.expr, row: str, param_names: Set[str]
+) -> Optional[int]:
+    """Max additive growth of an expression over the old value of `row`.
+
+    Understands the fold shapes update_health uses:
+      jnp.where(c, RESET, <expr>)   -> max over both branches
+      <plane-ref> + inc             -> bound(inc)
+      <plane-ref>                   -> 0
+      constant                      -> 0 (an absolute reset value)
+    Returns None when unprovable."""
+    if isinstance(node, ast.Constant):
+        return 0
+    if _is_plane_ref(node, row):
+        return 0
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "where"
+        and len(node.args) == 3
+    ):
+        a = _growth_bound(node.args[1], row, param_names)
+        b = _growth_bound(node.args[2], row, param_names)
+        if a is None or b is None:
+            return None
+        return max(a, b)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _growth_bound(node.left, row, param_names)
+        if left is not None:
+            inc = _increment_bound(node.right, param_names)
+            if inc is not None:
+                return left + inc
+        right = _growth_bound(node.right, row, param_names)
+        if right is not None:
+            inc = _increment_bound(node.left, param_names)
+            if inc is not None:
+                return right + inc
+    return None
+
+
+def _is_plane_ref(node: ast.expr, row: str) -> bool:
+    return (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.slice, ast.Name)
+        and node.slice.id == row
+    )
+
+
+def _increment_bound(
+    node: ast.expr, param_names: Set[str]
+) -> Optional[int]:
+    """Upper bound of an additive increment, or None when unprovable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in param_names
+    ):
+        # <bool param>.astype(...): a bool contributes at most 1.
+        return 1
+    if isinstance(node, ast.Name) and node.id in DECLARED_BOUNDED:
+        return DECLARED_BOUNDED[node.id]
+    return None
+
+
+# --- sim.py side ------------------------------------------------------------
+
+
+def check_sim(sf: SourceFile) -> Iterator[Violation]:
+    cluster: Optional[ast.ClassDef] = None
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ClusterSim":
+            cluster = node
+    if cluster is None:
+        return
+    drain_max: Optional[int] = None
+    drain_max_line = cluster.lineno
+    cap_expr: Optional[ast.expr] = None
+    cap_line: Optional[int] = None
+    wrap_guard = False
+    for node in ast.walk(cluster):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "_DRAIN_MAX"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                ):
+                    drain_max = node.value.value
+                    drain_max_line = node.lineno
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "_drain_cap"
+                ):
+                    cap_expr = node.value
+                    cap_line = node.lineno
+        elif isinstance(node, ast.FunctionDef) and node.name == "_drain_counters":
+            wrap_guard = _has_negative_raise(node)
+    if cap_expr is None:
+        if drain_max is not None:
+            yield _v(
+                sf,
+                drain_max_line,
+                "_DRAIN_MAX exists but the _drain_cap G-scaled ceiling is "
+                "gone; the drain window is no longer provably below the "
+                "int32 wrap bound",
+            )
+        # Otherwise: no counter-drain machinery in this file (a reduced
+        # fixture) — nothing to bound.
+        return
+    assert cap_line is not None
+    shift, budget = _parse_cap(cap_expr)
+    if shift is None or budget is None:
+        yield _v(
+            sf,
+            cap_line,
+            "the _drain_cap expression no longer matches "
+            "`max(1, min(self._DRAIN_MAX, (1 << S) // (K * cfg.n_groups)))` "
+            "— the GC008 overflow proof is tied to that shape; re-derive "
+            "the bound (docs/STATIC_ANALYSIS.md) and update the engine",
+        )
+        return
+    if shift > WRAP_SHIFT:
+        yield _v(
+            sf,
+            cap_line,
+            f"_drain_cap budgets 2**{shift} events per drain window but "
+            f"the int32 counter plane wraps at 2**{WRAP_SHIFT}; the drain "
+            "cadence can now outlive the wrap bound",
+        )
+    if budget < BUDGET_PER_GROUP:
+        yield _v(
+            sf,
+            cap_line,
+            f"_drain_cap assumes <= {budget} events/group/round but the "
+            f"GC008 registry declares the bound as {BUDGET_PER_GROUP}; a "
+            "window sized for the smaller rate can wrap — update the "
+            "registry only with a re-derived per-round budget",
+        )
+    if drain_max is not None and drain_max > (1 << WRAP_SHIFT) // BUDGET_PER_GROUP:
+        yield _v(
+            sf,
+            drain_max_line,
+            f"_DRAIN_MAX == {drain_max} exceeds the single-group wrap "
+            f"bound 2**{WRAP_SHIFT}/{BUDGET_PER_GROUP} rounds",
+        )
+    if not wrap_guard:
+        yield _v(
+            sf,
+            cap_line,
+            "the negative-counter wrap backstop (raise on v < 0 in "
+            "_drain_counters) is gone; the static bound loses its runtime "
+            "detectability net",
+        )
+
+
+def _has_negative_raise(func: ast.FunctionDef) -> bool:
+    """True iff _drain_counters raises under a `... < 0` test — the actual
+    wrap backstop, not just ANY raise somewhere in the class (unrelated
+    'disabled' RuntimeErrors must not satisfy this check)."""
+    for node in ast.walk(func):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        is_neg_test = (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Lt)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value == 0
+        )
+        if is_neg_test and any(
+            isinstance(sub, ast.Raise) for sub in ast.walk(node)
+        ):
+            return True
+    return False
+
+
+def _parse_cap(node: ast.expr) -> "tuple[Optional[int], Optional[int]]":
+    """Extract (S, K) from max(1, min(_DRAIN_MAX, (1 << S) // (K * G)))."""
+    if not (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "max"
+        and len(node.args) == 2
+    ):
+        return None, None
+    inner = node.args[1]
+    if not (
+        isinstance(inner, ast.Call)
+        and isinstance(inner.func, ast.Name)
+        and inner.func.id == "min"
+        and len(inner.args) == 2
+    ):
+        return None, None
+    div = inner.args[1]
+    if not (isinstance(div, ast.BinOp) and isinstance(div.op, ast.FloorDiv)):
+        return None, None
+    shift = _shift_value(div.left)
+    budget: Optional[int] = None
+    mul = div.right
+    if isinstance(mul, ast.BinOp) and isinstance(mul.op, ast.Mult):
+        for side in (mul.left, mul.right):
+            if isinstance(side, ast.Constant) and isinstance(side.value, int):
+                budget = side.value
+    return shift, budget
+
+
+def _shift_value(node: ast.expr) -> Optional[int]:
+    if (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, ast.LShift)
+        and isinstance(node.left, ast.Constant)
+        and node.left.value == 1
+        and isinstance(node.right, ast.Constant)
+        and isinstance(node.right.value, int)
+    ):
+        return node.right.value
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        v = node.value
+        return v.bit_length() - 1 if v > 0 and v & (v - 1) == 0 else None
+    return None

@@ -1,0 +1,213 @@
+"""Engine rule descriptors (GC007-GC010) + the GC009 interprocedural pass.
+
+The descriptors subclass ``Rule`` so the registry, ``--list-rules``, and
+allow-marker validation treat engine rules exactly like the per-file ones,
+but their per-file ``applies()`` is always False: engine rules need the
+whole module set at once and run through ``engine.run_engine`` instead.
+
+GC009 upgrades GC003 across call boundaries: GC003 trusts an ``int``/
+``bool`` annotation (or the ``cfg`` naming convention) to mean
+"compile-time static" inside the callee — so a call site passing a TRACED
+value into such a parameter smuggles tracing past the check and bakes one
+concrete branch into the compiled graph with no error at all.  GC009 walks
+every module-level function of the kernel modules (descending into nested
+defs with their closure's static names, which GC003's per-body pass cannot
+see) and flags any argument bound to a static-claimed parameter that the
+caller cannot prove static.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterator, List, Optional, Set
+
+from ..core import Context, Rule, SourceFile, Violation, walk_local
+from ..rules.gc002_hostsync import _is_kernel_module
+from ..rules.gc003_traced_branch import (
+    _StaticNames,
+    _module_constants,
+)
+
+GC009 = "GC009"
+GC009_SLUG = "traced-escape"
+
+_STATIC_CLAIM_ANNOTATIONS = {"int", "bool", "str", "float", "SimConfig"}
+
+
+class ShapeDtypeRule(Rule):
+    id = "GC007"
+    slug = "shape-dtype"
+    doc = "whole-program shape/dtype inference over the device modules (--engine)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False  # cross-module: runs via engine.run_engine
+
+
+class PlaneOverflowRule(Rule):
+    id = "GC008"
+    slug = "plane-overflow"
+    doc = "int32 planes provably cannot wrap between drains (--engine)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+class TracedEscapeRule(Rule):
+    id = "GC009"
+    slug = "traced-escape"
+    doc = "traced values cannot escape into static-claimed params (--engine)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+class ParityObligationsRule(Rule):
+    id = "GC010"
+    slug = "parity-obligations"
+    doc = "kernel parity obligations extracted, resolvable, and baselined (--engine)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+def engine_rules() -> List[Rule]:
+    return [
+        ShapeDtypeRule(),
+        PlaneOverflowRule(),
+        TracedEscapeRule(),
+        ParityObligationsRule(),
+    ]
+
+
+# --- GC009 ------------------------------------------------------------------
+
+
+class _StaticNamesX(_StaticNames):
+    """GC003's staticness inference + ``<static>._replace(**static)`` (a
+    NamedTuple config derived from a static config is still static)."""
+
+    def is_static(self, node: ast.expr) -> bool:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "_replace"
+            and self.is_static(node.func.value)
+            and all(self.is_static(kw.value) for kw in node.keywords)
+            and not node.args
+        ):
+            return True
+        return super().is_static(node)
+
+
+def _static_claimed_params(func: ast.FunctionDef) -> Dict[str, int]:
+    """parameter name -> position for params the callee treats as static."""
+    out: Dict[str, int] = {}
+    for i, arg in enumerate(func.args.args):
+        ann = arg.annotation
+        if (
+            isinstance(ann, ast.Name) and ann.id in _STATIC_CLAIM_ANNOTATIONS
+        ) or arg.arg == "cfg":
+            out[arg.arg] = i
+    for arg in func.args.kwonlyargs:
+        ann = arg.annotation
+        if (
+            isinstance(ann, ast.Name) and ann.id in _STATIC_CLAIM_ANNOTATIONS
+        ) or arg.arg == "cfg":
+            out[arg.arg] = -1  # keyword-only
+    return out
+
+
+class _Gc009Module:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.functions: Dict[str, ast.FunctionDef] = {
+            node.name: node
+            for node in ast.iter_child_nodes(sf.ast_tree)
+            if isinstance(node, ast.FunctionDef)
+        }
+        self.aliases: Dict[str, str] = {}
+        for node in ast.iter_child_nodes(sf.ast_tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    self.aliases[alias.asname or alias.name] = alias.name
+
+
+def check_traced_escape(
+    files: List[SourceFile], ctx: Context
+) -> Iterator[Violation]:
+    modules: Dict[str, _Gc009Module] = {}
+    for sf in files:
+        if sf.is_python and _is_kernel_module(sf.norm()):
+            short = sf.path.name[:-3]
+            modules[short] = _Gc009Module(sf)
+
+    def resolve(mod: _Gc009Module, func: ast.expr) -> Optional[ast.FunctionDef]:
+        if isinstance(func, ast.Name):
+            return mod.functions.get(func.id)
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            target = modules.get(mod.aliases.get(func.value.id, ""))
+            if target is not None:
+                return target.functions.get(func.attr)
+        return None
+
+    for mod in modules.values():
+        module_static = _module_constants(mod.sf.ast_tree)
+        for func in mod.functions.values():
+            yield from _check_function(mod, func, module_static, resolve)
+
+
+_Resolve = Callable[[_Gc009Module, ast.expr], Optional[ast.FunctionDef]]
+
+
+def _check_function(
+    mod: _Gc009Module,
+    func: ast.FunctionDef,
+    inherited: Set[str],
+    resolve: _Resolve,
+) -> Iterator[Violation]:
+    names = _StaticNamesX(func, inherited)
+    # Nested defs see the enclosing body's final static set (closure).
+    nested: List[ast.FunctionDef] = []
+    for node in walk_local(func):
+        if isinstance(node, ast.FunctionDef):
+            nested.append(node)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        callee = resolve(mod, node.func)
+        if callee is None or callee is func:
+            continue
+        claimed = _static_claimed_params(callee)
+        if not claimed:
+            continue
+        pos_params = [a.arg for a in callee.args.args]
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(pos_params):
+                pname = pos_params[i]
+                if pname in claimed and not names.is_static(arg):
+                    yield _gc009(mod.sf, arg.lineno, pname, callee.name)
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in claimed and not names.is_static(
+                kw.value
+            ):
+                yield _gc009(mod.sf, kw.value.lineno, kw.arg, callee.name)
+    for child in nested:
+        yield from _check_function(mod, child, names.static, resolve)
+
+
+def _gc009(
+    sf: SourceFile, lineno: int, pname: str, callee: str
+) -> Violation:
+    return Violation(
+        sf.display_path,
+        lineno,
+        GC009,
+        GC009_SLUG,
+        f"argument for `{pname}` of {callee}() is not provably static, but "
+        f"the callee treats `{pname}` as compile-time static (GC003 trusts "
+        "its annotation) — a traced value here bakes one concrete branch "
+        "into the compiled graph with no error; pass a Python int/bool or "
+        "re-anchor the parameter",
+    )

@@ -1,4 +1,10 @@
-"""Rule registry: one module per GC rule, assembled in id order."""
+"""Rule registry: one module per GC rule, assembled in id order.
+
+The engine rules (GC007-GC010) are cross-module and execute through
+``tools.graftcheck.engine.run_engine`` (the ``--engine`` flag), but they
+live in this registry too so ``--list-rules`` shows them and their
+``allow-GC00x`` markers validate like any other rule's.
+"""
 
 from __future__ import annotations
 
@@ -14,6 +20,8 @@ from .gc006_parity_map import KernelParityMap
 
 
 def all_rules() -> List[Rule]:
+    from ..engine.rules import engine_rules
+
     return [
         NoImplicitDtype(),
         NoHostSyncInJit(),
@@ -21,4 +29,4 @@ def all_rules() -> List[Rule]:
         MetricsGuarded(),
         CitationCheck(),
         KernelParityMap(),
-    ]
+    ] + engine_rules()
