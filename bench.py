@@ -35,6 +35,17 @@ Flags (all optional; defaults reproduce the BENCH_r0x methodology):
   --groups N      shrink the batch (CI artifact runs; default 100000).
   --reps N        repetition count (>=5 for comparable medians).
   --skip-anchor   skip the native-CPU anchor (vs_baseline becomes null).
+
+Chaos mode (docs/OBSERVABILITY.md "Chaos") replaces the steady bench:
+
+  --chaos F       run the chaos plan F (JSON, raft_tpu.multiraft.chaos)
+                  through the link-gated step as ONE compiled lax.scan per
+                  rep; the JSON line carries the scenario summary (MTTR /
+                  time-to-reelect off the health planes, safety-invariant
+                  counts — all zero or the run fails) instead of
+                  vs_baseline.
+  --chaos-out F   also write the scenario-summary JSON to F (the CI
+                  artifact next to the health summary).
 """
 
 import argparse
@@ -182,6 +193,55 @@ def bench_device(
     return rep_stats(samples)
 
 
+def bench_chaos(
+    plan_path: str, groups: int, reps: int, chaos_out: str = ""
+) -> dict:
+    """Run a chaos plan as one compiled scan per rep and report both the
+    scenario summary and the chaos-path throughput."""
+    from raft_tpu.multiraft import chaos, sim
+    from raft_tpu.multiraft.health import HealthMonitor
+    from raft_tpu.multiraft.sim import SimConfig
+
+    plan = chaos.load_plan(plan_path)
+    cfg = SimConfig(
+        n_groups=groups, n_peers=plan.n_peers, collect_health=True
+    )
+    compiled = chaos.compile_plan(plan, groups)
+    runner = chaos.make_runner(cfg, compiled)
+
+    def fresh():
+        return sim.init_state(cfg), sim.init_health(cfg)
+
+    st, hl = fresh()
+    st, hl, stats, safety = runner(st, hl)  # compile + first run
+    jax.block_until_ready(stats)
+    samples = []
+    for _ in range(reps):
+        st, hl = fresh()
+        jax.block_until_ready((st, hl))
+        t0 = time.perf_counter()
+        st, hl, stats, safety = runner(st, hl)
+        jax.block_until_ready(stats)
+        samples.append(groups * plan.n_rounds / (time.perf_counter() - t0))
+    stats_h, safety_h = jax.device_get((stats, safety))
+    report = HealthMonitor.chaos_report(stats_h, safety_h, plan.n_rounds)
+    report["plan"] = plan.name
+    report["groups"] = groups
+    report["peers"] = plan.n_peers
+    report["phases"] = len(plan.phases)
+    if chaos_out:
+        with open(chaos_out, "w") as f:
+            json.dump(report, f)
+    if any(report["safety"].values()):
+        print(
+            f"ERROR: chaos plan {plan.name} violated safety invariants: "
+            f"{report['safety']}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return {"report": report, **rep_stats(samples)}
+
+
 def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
@@ -219,9 +279,28 @@ def main() -> None:
     ap.add_argument("--groups", type=int, default=G)
     ap.add_argument("--reps", type=int, default=REPS)
     ap.add_argument("--skip-anchor", action="store_true")
+    ap.add_argument("--chaos", default="", metavar="PLAN_JSON")
+    ap.add_argument("--chaos-out", default="", metavar="FILE")
     args = ap.parse_args()
     if args.health_out and not args.health:
         ap.error("--health-out requires --health")
+    if args.chaos_out and not args.chaos:
+        ap.error("--chaos-out requires --chaos")
+
+    if args.chaos:
+        chaos_stats = bench_chaos(
+            args.chaos, args.groups, args.reps, args.chaos_out
+        )
+        warn_spread("chaos device", chaos_stats)
+        line = {
+            "metric": "raft_chaos_ticks_per_sec",
+            "value": chaos_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            **chaos_stats,
+        }
+        print(json.dumps(line))
+        return
 
     device = bench_device(
         groups=args.groups,

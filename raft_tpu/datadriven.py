@@ -47,6 +47,10 @@ class TestData:
     cmd_args: List[CmdArg] = field(default_factory=list)
     input: str = ""
     expected: str = ""
+    # The verbatim directive line, kept so rewrite mode reproduces it
+    # exactly (recovering it from a text scan mis-fires when a case has no
+    # input lines and the scan window drifts into OUTPUT lines).
+    directive_line: str = ""
 
     def arg(self, key: str) -> Optional[CmdArg]:
         for a in self.cmd_args:
@@ -109,6 +113,7 @@ def parse_file(path: str) -> List[TestData]:
             continue
         td = TestData(pos=f"{path}:{i + 1}")
         td.cmd, td.cmd_args = _parse_args(line.strip())
+        td.directive_line = line.strip()
         i += 1
         # input lines until the ---- separator
         input_lines = []
@@ -129,7 +134,7 @@ def parse_file(path: str) -> List[TestData]:
 
 
 def _render(td: TestData, output: str) -> str:
-    out = [td._directive_line]  # type: ignore[attr-defined]
+    out = [td.directive_line or td.cmd]
     if td.input:
         out.append(td.input)
     out.append("----")
@@ -148,26 +153,13 @@ def run_test(
     if rewrite is None:
         rewrite = os.environ.get("RAFT_TPU_REWRITE") == "1"
 
-    # Keep raw directive lines for faithful rewrite.
-    raw_directives = []
-    with open(path) as f:
-        for line in f:
-            s = line.strip()
-            if s and not s.startswith("#") and s != "----":
-                raw_directives.append(s)
-
     cases = parse_file(path)
     outputs = []
     for td in cases:
         outputs.append(handler(td).rstrip("\n"))
 
     if rewrite:
-        blocks = []
-        di = 0
-        for td, out in zip(cases, outputs):
-            td._directive_line = _find_directive(raw_directives, di, td)  # type: ignore[attr-defined]
-            di += 1 + (len(td.input.splitlines()) if td.input else 0)
-            blocks.append(_render(td, out))
+        blocks = [_render(td, out) for td, out in zip(cases, outputs)]
         with open(path, "w") as f:
             f.write("\n\n".join(blocks) + "\n")
         return
@@ -177,13 +169,6 @@ def run_test(
             f"{td.pos}: output mismatch for `{td.cmd}`\n"
             f"--- expected ---\n{td.expected}\n--- got ---\n{out}"
         )
-
-
-def _find_directive(raw: List[str], start: int, td: TestData) -> str:
-    for s in raw[start : start + 1 + len(td.input.splitlines())]:
-        if s.split()[0] == td.cmd:
-            return s
-    return td.cmd
 
 
 def walk(dir: str, handler_for_file: Callable[[str], None]) -> None:

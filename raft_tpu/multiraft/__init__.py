@@ -34,9 +34,10 @@ from .sim import (
     init_health,
     read_index,
 )
-from .simref import HealthOracle, ScalarCluster
+from .simref import ChaosOracle, HealthOracle, ScalarCluster
 
 __all__ = [
+    "ChaosOracle",
     "committed_index",
     "committed_index_grouped",
     "joint_committed_index",
@@ -52,6 +53,7 @@ __all__ = [
     "HealthOracle",
     "read_index",
     # submodules imported lazily to keep jax-light paths cheap:
+    #   .chaos     fault-plan compiler + compiled-schedule runner
     #   .driver    MultiRaft host driver
     #   .native    NativeMultiRaft C++ engine bindings
     #   .pallas_step  fused steady-round kernels

@@ -1,0 +1,425 @@
+"""Device-resident chaos engine: declarative fault plans compiled into
+on-device schedules for the batched sim.
+
+The fault surface is the pairwise link plane `link[P, P, G]` threaded
+through ``sim.step`` (see ``sim._linked_step``): a whole-peer crash is the
+special case ``link[p, :, g] = link[:, p, g] = False``, an asymmetric
+partition is a directed subset, and per-link message loss is a seeded
+per-round draw (``kernels.link_loss_draw``, keyed ``(round, src, dst,
+group)`` so every run replays bit-exactly).
+
+A :class:`ChaosPlan` is a list of phases — partitions, directed link
+overrides, loss rates, crashes, heals — each covering a round range and an
+optional group selector.  :func:`compile_plan` lowers it host-side into
+dense per-phase schedule arrays; :func:`run_plan` then executes the whole
+multi-phase scenario inside ONE jitted ``lax.scan`` with zero host round
+trips: per-round masks are gathered from the schedule by phase index, the
+loss plane is drawn on device, the link-gated step advances every group,
+``kernels.check_safety`` folds the safety invariants (election safety,
+committed-prefix agreement, commit monotonicity) into a violation
+accumulator, and the health planes feed a time-to-reelect / MTTR
+accumulator (``health.chaos_report`` formats the host-side summary).
+
+Plan JSON (see docs/OBSERVABILITY.md "Chaos" and tests/testdata/chaos/)::
+
+    {"name": "split-brain", "peers": 5, "phases": [
+        {"rounds": 30},                                   # settle
+        {"rounds": 40, "partition": [[1, 2], [3, 4, 5]],  # symmetric split
+         "append": 1},
+        {"rounds": 20, "links": [{"from": 1, "to": 2, "up": false}],
+         "loss": [{"from": 3, "to": 4, "rate": 0.5}],
+         "crash": [5], "groups": {"mod": 2, "eq": 0}},
+        {"rounds": 30, "heal": true}]}
+
+The scalar twin is ``simref.ChaosOracle``: it replays the SAME compiled
+schedule through real Raft state machines and the harness Network's
+per-edge drops — :func:`host_masks` / :func:`host_loss_draw` are the numpy
+mirrors of the device schedule and must stay bit-identical
+(tests/test_chaos_parity.py).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import kernels
+from . import sim as sim_mod
+
+
+# Group selectors: "all", an explicit id list, or {"mod": m, "eq": r}.
+GroupSel = Union[str, Sequence[int], Dict[str, int]]
+
+
+@dataclass
+class ChaosPhase:
+    """One contiguous stretch of rounds with a fixed fault topology.
+
+    rounds:    phase length in protocol rounds (>= 1).
+    partition: list of peer-id cells; links BETWEEN cells are down, links
+               within a cell stay up.  Peers in no cell form one implicit
+               extra cell.  None = no partition.
+    links:     directed overrides [{"from": a, "to": b, "up": bool}],
+               applied after the partition.
+    loss:      directed loss rates [{"from": a, "to": b, "rate": 0..1}];
+               "rate" is sampled per (round, link, group).
+    loss_all:  uniform loss rate applied to every directed link first.
+    crash:     peer ids crashed (fully isolated) for the phase.
+    groups:    which groups the phase's faults apply to; non-selected
+               groups run fault-free for the phase.
+    append:    per-round append workload proposed at each group's leader.
+    """
+
+    rounds: int
+    partition: Optional[List[List[int]]] = None
+    links: List[Dict[str, object]] = field(default_factory=list)
+    loss: List[Dict[str, object]] = field(default_factory=list)
+    loss_all: float = 0.0
+    crash: List[int] = field(default_factory=list)
+    groups: GroupSel = "all"
+    append: int = 0
+
+
+@dataclass
+class ChaosPlan:
+    """A named multi-phase fault scenario (host-side, declarative)."""
+
+    name: str
+    n_peers: int
+    phases: List[ChaosPhase]
+
+    @property
+    def n_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+
+def plan_from_dict(doc: Dict[str, object]) -> ChaosPlan:
+    """Build a ChaosPlan from its JSON document form (see module doc)."""
+    phases: List[ChaosPhase] = []
+    for ph in doc["phases"]:  # type: ignore[index]
+        if not isinstance(ph, dict):
+            raise ValueError(f"phase is not an object: {ph!r}")
+        if ph.get("heal"):
+            ph = {"rounds": ph["rounds"], "append": ph.get("append", 0)}
+        phases.append(
+            ChaosPhase(
+                rounds=int(ph["rounds"]),  # type: ignore[arg-type]
+                partition=ph.get("partition"),  # type: ignore[arg-type]
+                links=list(ph.get("links", [])),  # type: ignore[arg-type]
+                loss=list(ph.get("loss", [])),  # type: ignore[arg-type]
+                loss_all=float(ph.get("loss_all", 0.0)),  # type: ignore[arg-type]
+                crash=[int(p) for p in ph.get("crash", [])],  # type: ignore[union-attr]
+                groups=ph.get("groups", "all"),  # type: ignore[arg-type]
+                append=int(ph.get("append", 0)),  # type: ignore[arg-type]
+            )
+        )
+    return ChaosPlan(
+        name=str(doc.get("name", "unnamed")),
+        n_peers=int(doc["peers"]),  # type: ignore[arg-type]
+        phases=phases,
+    )
+
+
+def load_plan(path: str) -> ChaosPlan:
+    """Load a ChaosPlan from a JSON file (the bench.py --chaos input)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return plan_from_dict(json.load(f))
+
+
+def _group_mask(sel: GroupSel, n_groups: int) -> np.ndarray:
+    if isinstance(sel, str):
+        if sel != "all":
+            raise ValueError(f"unknown group selector {sel!r}")
+        return np.ones(n_groups, dtype=bool)
+    if isinstance(sel, dict):
+        m, r = int(sel["mod"]), int(sel["eq"])
+        return (np.arange(n_groups) % m) == r
+    mask = np.zeros(n_groups, dtype=bool)
+    for g in sel:
+        if not 0 <= int(g) < n_groups:
+            raise ValueError(
+                f"group id {g} out of range [0, {n_groups})"
+            )
+        mask[int(g)] = True
+    return mask
+
+
+def _peer_index(pid: object, n_peers: int, what: str, phase: int) -> int:
+    """Validate a 1-based peer id from a plan document -> 0-based index
+    (a 0 or negative id would otherwise silently wrap into the wrong
+    peer's link row)."""
+    p = int(pid)  # type: ignore[call-overload]
+    if not 1 <= p <= n_peers:
+        raise ValueError(
+            f"phase {phase}: {what} peer id {p} out of range [1, {n_peers}]"
+        )
+    return p - 1
+
+
+def _rate_to_fp(rate: float) -> int:
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"loss rate {rate} outside [0, 1]")
+    return int(round(rate * kernels.LOSS_SCALE))
+
+
+class CompiledChaos(NamedTuple):
+    """Device schedule arrays for one plan at one batch shape.
+
+    phase_of_round: int32[R]           round -> phase index
+    link:           bool[NPH, P, P, G] per-phase base link plane
+    loss:           int32[NPH, P, P, G] per-phase loss rates (1/LOSS_SCALE)
+    crashed:        bool[NPH, P, G]    per-phase crash masks
+    append:         int32[NPH, G]      per-phase append workload
+    """
+
+    phase_of_round: jnp.ndarray  # gc: int32[R]
+    link: jnp.ndarray  # gc: bool[NPH, P, P, G]
+    loss: jnp.ndarray  # gc: int32[NPH, P, P, G]
+    crashed: jnp.ndarray  # gc: bool[NPH, P, G]
+    append: jnp.ndarray  # gc: int32[NPH, G]
+
+    @property
+    def n_rounds(self) -> int:
+        return int(self.phase_of_round.shape[0])
+
+
+def _compile_arrays(
+    plan: ChaosPlan, n_groups: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The numpy schedule (shared by the device path and the oracle)."""
+    P, G = plan.n_peers, n_groups
+    nph = len(plan.phases)
+    if nph == 0:
+        raise ValueError("plan has no phases")
+    phase_of_round = np.zeros(plan.n_rounds, dtype=np.int32)
+    link = np.ones((nph, P, P, G), dtype=bool)
+    loss = np.zeros((nph, P, P, G), dtype=np.int32)
+    crashed = np.zeros((nph, P, G), dtype=bool)
+    append = np.zeros((nph, G), dtype=np.int32)
+    r0 = 0
+    for i, ph in enumerate(plan.phases):
+        if ph.rounds < 1:
+            raise ValueError(f"phase {i}: rounds must be >= 1")
+        phase_of_round[r0 : r0 + ph.rounds] = i
+        r0 += ph.rounds
+        gsel = _group_mask(ph.groups, G)
+        lk = np.ones((P, P), dtype=bool)
+        if ph.partition is not None:
+            cell = np.full(P, -1, dtype=np.int64)
+            for c, ids in enumerate(ph.partition):
+                for pid in ids:
+                    cell[_peer_index(pid, P, "partition", i)] = c
+            cell[cell < 0] = len(ph.partition)  # implicit last cell
+            lk = cell[:, None] == cell[None, :]
+        for ov in ph.links:
+            a = _peer_index(ov["from"], P, "link", i)
+            b = _peer_index(ov["to"], P, "link", i)
+            lk[a, b] = bool(ov.get("up", False))
+        ls = np.full((P, P), _rate_to_fp(ph.loss_all), dtype=np.int32)
+        for ov in ph.loss:
+            a = _peer_index(ov["from"], P, "loss", i)
+            b = _peer_index(ov["to"], P, "loss", i)
+            ls[a, b] = _rate_to_fp(float(ov["rate"]))  # type: ignore[arg-type]
+        link[i] = np.where(gsel[None, None, :], lk[:, :, None], True)
+        loss[i] = np.where(gsel[None, None, :], ls[:, :, None], 0)
+        for pid in ph.crash:
+            crashed[i, _peer_index(pid, P, "crash", i)] = gsel
+        append[i] = np.where(gsel, ph.append, 0)
+    # The chaos-stats accumulator sums per-group indicators over the run in
+    # int32 (see run_plan); bound the schedule so it provably cannot wrap
+    # (the GC008 discipline, derived in docs/STATIC_ANALYSIS.md).
+    if plan.n_rounds * max(1, G) >= 2**31:
+        raise ValueError(
+            f"plan spans {plan.n_rounds} rounds x {G} groups >= 2**31 "
+            "(group, round) pairs; the int32 chaos-stats accumulator "
+            "could wrap — split the plan"
+        )
+    return phase_of_round, link, loss, crashed, append
+
+
+def compile_plan(plan: ChaosPlan, n_groups: int) -> CompiledChaos:
+    """Lower a ChaosPlan to device schedule arrays for `n_groups` groups."""
+    phase_of_round, link, loss, crashed, append = _compile_arrays(
+        plan, n_groups
+    )
+    return CompiledChaos(
+        phase_of_round=jnp.asarray(phase_of_round, dtype=jnp.int32),
+        link=jnp.asarray(link, dtype=bool),
+        loss=jnp.asarray(loss, dtype=jnp.int32),
+        crashed=jnp.asarray(crashed, dtype=bool),
+        append=jnp.asarray(append, dtype=jnp.int32),
+    )
+
+
+def schedule_masks(
+    compiled: CompiledChaos,
+    round_idx: jnp.ndarray,  # gc: int32[]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device-side (link, crashed, append) for one round of the schedule:
+    gather the round's phase row and knock out the seeded loss sample."""
+    ph = compiled.phase_of_round[round_idx]
+    drop = kernels.link_loss_draw(round_idx, compiled.loss[ph])
+    return compiled.link[ph] & ~drop, compiled.crashed[ph], compiled.append[ph]
+
+
+# --- host twins (the ChaosOracle side; must stay bit-identical) -----------
+
+
+def host_loss_draw(round_idx: int, loss_rate: np.ndarray) -> np.ndarray:
+    """Numpy twin of kernels.link_loss_draw (same counter PRNG, same key
+    layout); tests/test_chaos_parity.py pins bit-equality."""
+    P = loss_rate.shape[0]
+    G = loss_rate.shape[2]
+    g = np.arange(G, dtype=np.uint32)[None, None, :]
+    s = np.arange(P, dtype=np.uint32)[:, None, None]
+    d = np.arange(P, dtype=np.uint32)[None, :, None]
+    lane = s * np.uint32(P) + d + np.uint32(1)
+
+    def mix(x: np.ndarray) -> np.ndarray:
+        x = x.astype(np.uint32)
+        x ^= x >> np.uint32(16)
+        x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        x ^= x >> np.uint32(13)
+        x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        x ^= x >> np.uint32(16)
+        return x
+
+    x = mix(
+        (g * np.uint32(0x9E3779B1) + np.uint32(round_idx)).astype(np.uint32)
+    )
+    x = mix(x ^ (lane * np.uint32(0x85EBCA6B)).astype(np.uint32))
+    return (x % np.uint32(kernels.LOSS_SCALE)).astype(np.int32) < loss_rate
+
+
+class HostSchedule:
+    """The compiled schedule kept in numpy — what simref.ChaosOracle walks.
+
+    Round r's effective masks are exactly what schedule_masks hands the
+    device step: base link plane of the round's phase, minus the seeded
+    loss sample, plus the phase crash mask and append workload.
+    """
+
+    def __init__(self, plan: ChaosPlan, n_groups: int):
+        (
+            self.phase_of_round,
+            self.link,
+            self.loss,
+            self.crashed,
+            self.append,
+        ) = _compile_arrays(plan, n_groups)
+        self.n_rounds = plan.n_rounds
+        self.n_peers = plan.n_peers
+        self.n_groups = n_groups
+
+    def masks(
+        self, round_idx: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(link[P, P, G], crashed[P, G], append[G]) for one round."""
+        ph = int(self.phase_of_round[round_idx])
+        drop = host_loss_draw(round_idx, self.loss[ph])
+        return self.link[ph] & ~drop, self.crashed[ph], self.append[ph]
+
+
+# --- the compiled-run harness ---------------------------------------------
+
+# Chaos-stats accumulator indices ([N_CHAOS_STATS] int32; time-to-reelect /
+# MTTR off the PR 3 health planes — health.chaos_report formats them).
+CS_REELECTIONS = 0  # leaderless episodes that ended (leader regained)
+CS_HEALED_ROUNDS = 1  # summed length of ended episodes (MTTR numerator)
+CS_MAX_STREAK = 2  # longest leaderless streak observed anywhere
+CS_LEADERLESS_ROUNDS = 3  # total leaderless (group, round) pairs
+N_CHAOS_STATS = 4
+
+CHAOS_STAT_NAMES = (
+    "reelections",
+    "healed_rounds",
+    "max_leaderless_streak",
+    "leaderless_group_rounds",
+)
+
+
+def update_chaos_stats(
+    stats: jnp.ndarray,  # gc: int32[S]
+    prev_leaderless: jnp.ndarray,  # gc: int32[G]
+    new_leaderless: jnp.ndarray,  # gc: int32[G]
+) -> jnp.ndarray:
+    """Fold one round's leaderless-plane transition into the stats."""
+    healed = (prev_leaderless > 0) & (new_leaderless == 0)
+    # dtype= on the sums: bare reductions widen to int64 under x64 (GC007).
+    delta = jnp.stack(
+        [
+            jnp.sum(healed, dtype=jnp.int32),
+            jnp.sum(jnp.where(healed, prev_leaderless, 0), dtype=jnp.int32),
+            jnp.int32(0),
+            jnp.sum(new_leaderless > 0, dtype=jnp.int32),
+        ]
+    )
+    out = stats + delta
+    return out.at[CS_MAX_STREAK].set(
+        jnp.maximum(stats[CS_MAX_STREAK], jnp.max(new_leaderless))
+    )
+
+
+def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
+    """Build the jitted whole-scenario runner: one lax.scan over every
+    round of the compiled schedule with zero host round trips inside —
+    per-round masks gathered on device, the link-gated step, the safety
+    fold, and the MTTR stats fold all fuse into the scan body.
+
+    Returns a callable (state, health) -> (state', health',
+    stats[N_CHAOS_STATS], safety[N_SAFETY]); both inputs are donated.
+    Build once and call repeatedly (bench reps) — each make_runner call
+    compiles afresh.
+    """
+
+    def body(carry, r):
+        st, hl, stats, safety = carry
+        link, crashed, append = schedule_masks(compiled, r)
+        prev_leaderless = hl.planes[kernels.HP_LEADERLESS]
+        st2, hl2 = sim_mod.step(
+            cfg, st, crashed, append, health=hl, link=link
+        )
+        safety = safety + kernels.check_safety(
+            st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
+            st.commit,
+        )
+        stats = update_chaos_stats(
+            stats, prev_leaderless, hl2.planes[kernels.HP_LEADERLESS]
+        )
+        return (st2, hl2, stats, safety), ()
+
+    def run(st, hl):
+        stats = jnp.zeros((N_CHAOS_STATS,), jnp.int32)
+        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        carry, _ = jax.lax.scan(
+            body,
+            (st, hl, stats, safety),
+            jnp.arange(compiled.n_rounds, dtype=jnp.int32),
+        )
+        return carry
+
+    return jax.jit(run, donate_argnums=(0, 1))
+
+
+def run_plan(
+    cfg: sim_mod.SimConfig,
+    state: sim_mod.SimState,
+    compiled: CompiledChaos,
+    health: Optional[sim_mod.HealthState] = None,
+) -> Tuple[sim_mod.SimState, sim_mod.HealthState, jnp.ndarray, jnp.ndarray]:
+    """Execute a whole compiled scenario in one jitted lax.scan.
+
+    Returns (state', health', stats[N_CHAOS_STATS], safety[N_SAFETY]) —
+    all device arrays; nothing crosses to the host inside the run.  The
+    health planes are REQUIRED (the MTTR stats ride on HP_LEADERLESS):
+    pass an existing HealthState to continue its windows, or None to start
+    fresh.
+    """
+    if health is None:
+        health = sim_mod.init_health(cfg)
+    return make_runner(cfg, compiled)(state, health)

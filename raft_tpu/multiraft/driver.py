@@ -119,6 +119,13 @@ class MultiRaft:
             self._h_prev_term = self._term.copy()
             self._h_window_pos = 0
             self._h_ticks = 0
+            # Time-to-reelect accounting (the host twin of the chaos
+            # engine's device-side MTTR stats — chaos.update_chaos_stats):
+            # an episode ends when a leaderless group regains a leader.
+            self._h_reelections = 0
+            self._h_healed_ticks = 0
+            self._h_max_streak = 0
+            self._h_leaderless_ticks_total = 0
 
         et, ht = self.election_tick, self.heartbeat_tick
 
@@ -226,7 +233,14 @@ class MultiRaft:
         if hc is None:
             return
         has_leader = self._leader != 0
+        healed = has_leader & (self._h_leaderless > 0)
+        self._h_reelections += int(healed.sum())
+        self._h_healed_ticks += int(self._h_leaderless[healed].sum())
         self._h_leaderless = np.where(has_leader, 0, self._h_leaderless + 1)
+        self._h_max_streak = max(
+            self._h_max_streak, int(self._h_leaderless.max(initial=0))
+        )
+        self._h_leaderless_ticks_total += int((~has_leader).sum())
         advanced = self._commit > self._h_prev_commit
         self._h_since_commit = np.where(
             advanced, 0, self._h_since_commit + 1
@@ -265,6 +279,28 @@ class MultiRaft:
         k = min(hc.topk, self.G)
         order = np.argsort(-score, kind="stable")[:k]
         return HealthMonitor.summary_dict(counts, hist, order, score[order])
+
+    def mttr(self) -> Dict[str, object]:
+        """Time-to-reelect facts off the health planes, in driver TICKS
+        (the host twin of the chaos engine's per-scenario MTTR report —
+        docs/OBSERVABILITY.md "Chaos"): mean leaderless-episode length
+        over episodes that ended with a leader regained, plus the worst
+        streak and the cumulative leaderless (group, tick) count."""
+        if self.health_config is None:
+            raise RuntimeError(
+                "health disabled; construct MultiRaft with "
+                "health=HealthConfig(...)"
+            )
+        return {
+            "mttr_ticks": (
+                round(self._h_healed_ticks / self._h_reelections, 3)
+                if self._h_reelections
+                else None
+            ),
+            "reelections": self._h_reelections,
+            "max_leaderless_streak": self._h_max_streak,
+            "leaderless_group_ticks": self._h_leaderless_ticks_total,
+        }
 
     def health(self) -> Dict[str, object]:
         """Current fleet-health summary (requires the health=HealthConfig

@@ -108,6 +108,75 @@ class HealthMonitor:
                 m.trace("health.churn", churning=counts.get("churning", 0))
         return entry
 
+    @staticmethod
+    def chaos_report(stats, safety, rounds: int) -> dict:
+        """Per-scenario chaos summary off the device accumulators.
+
+        stats:  [chaos.N_CHAOS_STATS] int32 vector (CS_* indices) — the
+                time-to-reelect facts folded from the HP_LEADERLESS
+                health plane every round of the compiled run.
+        safety: [kernels.N_SAFETY] int32 violation counts (SV_*
+                indices); all-zero on every correct run — the chaos fuzz
+                harness asserts it.
+        rounds: rounds executed (python int, from the compiled plan).
+
+        Returns the scenario-summary dict bench.py --chaos emits as a CI
+        artifact::
+
+            {"rounds": R,
+             "mttr_rounds": mean leaderless-episode length (None when no
+                            episode ended),
+             "reelections": episodes that ended with a leader regained,
+             "max_leaderless_streak": worst streak observed anywhere,
+             "leaderless_group_rounds": leaderless (group, round) pairs,
+             "safety": {"dual_leader": 0, ...}}
+        """
+        from .chaos import (
+            CS_HEALED_ROUNDS,
+            CS_LEADERLESS_ROUNDS,
+            CS_MAX_STREAK,
+            CS_REELECTIONS,
+        )
+        from .kernels import SAFETY_NAMES
+
+        reelections = int(stats[CS_REELECTIONS])
+        healed = int(stats[CS_HEALED_ROUNDS])
+        return {
+            "rounds": int(rounds),
+            "mttr_rounds": (
+                round(healed / reelections, 3) if reelections else None
+            ),
+            "reelections": reelections,
+            "max_leaderless_streak": int(stats[CS_MAX_STREAK]),
+            "leaderless_group_rounds": int(stats[CS_LEADERLESS_ROUNDS]),
+            "safety": {
+                name: int(v) for name, v in zip(SAFETY_NAMES, safety)
+            },
+        }
+
+    def record_scenario(self, report: dict) -> dict:
+        """Fold a chaos scenario report (chaos_report's shape) into the
+        flight recorder and trace stream; safety violations raise a
+        `chaos.safety` trace event so they can never scroll by silently."""
+        with self._lock:
+            entry = {"seq": self._seq, "ts": time.time(), "chaos": report}
+            self._seq += 1
+            self._ring.append(entry)
+        m = self.metrics
+        if m is not None:
+            m.trace(
+                "chaos.scenario",
+                rounds=report.get("rounds", 0),
+                mttr_rounds=report.get("mttr_rounds"),
+                reelections=report.get("reelections", 0),
+                max_leaderless_streak=report.get(
+                    "max_leaderless_streak", 0
+                ),
+            )
+            if any(report.get("safety", {}).values()):
+                m.trace("chaos.safety", **report["safety"])
+        return entry
+
     def last(self) -> Optional[dict]:
         """Most recent flight-recorder entry, or None."""
         with self._lock:

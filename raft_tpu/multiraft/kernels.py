@@ -35,6 +35,14 @@ here is missing from it or untested under tests/.
                                lag histogram, lax.top_k worst offenders);
                                parity vs a host argsort in
                                tests/test_health_parity.py
+  link_loss_draw           <-> the host-side schedule twin
+                               (tests/test_chaos_parity.py asserts bit-exact
+                               equality with chaos host_loss_draw, the numpy
+                               half of the ChaosOracle fault schedules)
+  check_safety             <-> the Raft safety arguments themselves
+                               (tests/test_chaos_parity.py drives it every
+                               fuzz round; ChaosOracle holds the scalar
+                               state it must never flag)
 
 TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
 committed_index is a fixed-width masked sort along the last axis that XLA
@@ -218,6 +226,114 @@ def joint_vote_result(
     won = (i == VOTE_WON) & (o == VOTE_WON)
     lost = (i == VOTE_LOST) | (o == VOTE_LOST)
     return jnp.where(won, VOTE_WON, jnp.where(lost, VOTE_LOST, VOTE_PENDING))
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit murmur3 finalizer — the shared mixer behind timeout_draw and
+    link_loss_draw (the host twin is chaos.host_loss_draw's inline copy)."""
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    return x
+
+
+LOSS_SCALE = 10_000  # loss rates are int32 fixed-point per-ten-thousand
+
+
+def link_loss_draw(
+    round_idx: jnp.ndarray,  # gc: int32[]
+    loss_rate: jnp.ndarray,  # gc: int32[P, P, G]
+) -> jnp.ndarray:
+    """Seeded per-link message-loss sample for one protocol round.
+
+    round_idx: int32 scalar, the round number (the replay key).
+    loss_rate: int32[P, P, G] per-directed-link loss probability in units
+               of 1/LOSS_SCALE (0 = lossless, LOSS_SCALE = always down).
+
+    Returns bool[P, P, G]: True where the (src, dst, group) link drops all
+    messages this round.  The draw is a counter PRNG keyed
+    (round, src, dst, group) — no state, so any round of any schedule can
+    be replayed in isolation bit-exactly; chaos.host_loss_draw is the
+    numpy twin the ChaosOracle uses and must stay bit-identical
+    (tests/test_chaos_parity.py).
+    """
+    P = loss_rate.shape[0]
+    G = loss_rate.shape[2]
+    g = jnp.arange(G, dtype=jnp.uint32)[None, None, :]
+    s = jnp.arange(P, dtype=jnp.uint32)[:, None, None]
+    d = jnp.arange(P, dtype=jnp.uint32)[None, :, None]
+    lane = s * jnp.uint32(P) + d + jnp.uint32(1)
+    x = _mix32(g * jnp.uint32(0x9E3779B1) + round_idx.astype(jnp.uint32))
+    x = _mix32(x ^ (lane * jnp.uint32(0x85EBCA6B)))
+    return (x % jnp.uint32(LOSS_SCALE)).astype(jnp.int32) < loss_rate
+
+
+# check_safety violation-count vector indices.
+SV_DUAL_LEADER = 0  # two leaders share a term in one group
+SV_COMMIT_DIVERGED = 1  # two peers' committed prefixes disagree
+SV_COMMIT_REGRESSED = 2  # some peer's commit index decreased
+SV_CURSOR_INVALID = 3  # agree/commit cursors exceed log bounds
+N_SAFETY = 4
+
+SAFETY_NAMES = (
+    "dual_leader",
+    "commit_diverged",
+    "commit_regressed",
+    "cursor_invalid",
+)
+
+
+def check_safety(
+    state: jnp.ndarray,  # gc: int32[P, G]
+    term: jnp.ndarray,  # gc: int32[P, G]
+    commit: jnp.ndarray,  # gc: int32[P, G]
+    last_index: jnp.ndarray,  # gc: int32[P, G]
+    agree: jnp.ndarray,  # gc: int32[P, P, G]
+    prev_commit: jnp.ndarray,  # gc: int32[P, G]
+) -> jnp.ndarray:
+    """Device-side Raft safety invariants over one round boundary.
+
+    Returns int32[N_SAFETY] counts of violating groups (SV_* indices) —
+    all-zero on every reachable state:
+
+      * election safety: at most one leader per (group, term);
+      * log matching at commit: any two peers' committed prefixes agree
+        (min(commit_a, commit_b) <= agree[a, b] — index+term identify
+        entries, so a shorter common prefix than either commit is a lost
+        committed entry);
+      * commit monotonicity: no peer's commit index decreases;
+      * cursor sanity: commit <= last_index and
+        agree[a, b] <= min(last_a, last_b).
+
+    The chaos fuzz harness folds these counts into the compiled schedule
+    scan every round and asserts the run total is zero.
+    """
+    P = state.shape[0]
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    is_lead = state == ROLE_LEADER
+    dual = (
+        is_lead[:, None, :]
+        & is_lead[None, :, :]
+        & (term[:, None, :] == term[None, :, :])
+        & off_diag
+    )
+    cmin = jnp.minimum(commit[:, None, :], commit[None, :, :])
+    diverged = (cmin > agree) & off_diag
+    regressed = commit < prev_commit
+    lmin = jnp.minimum(last_index[:, None, :], last_index[None, :, :])
+    invalid = ((agree > lmin) & off_diag) | (commit > last_index)[:, None, :]
+    # dtype= on the group counts: a bare bool sum widens to int64 under x64
+    # (GC007), and these feed an int32 scan accumulator.
+    return jnp.stack(
+        [
+            jnp.sum(jnp.any(dual, axis=(0, 1)), dtype=jnp.int32),
+            jnp.sum(jnp.any(diverged, axis=(0, 1)), dtype=jnp.int32),
+            jnp.sum(jnp.any(regressed, axis=0), dtype=jnp.int32),
+            jnp.sum(jnp.any(invalid, axis=(0, 1)), dtype=jnp.int32),
+        ]
+    )
 
 
 def timeout_draw(

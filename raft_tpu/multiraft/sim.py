@@ -28,10 +28,19 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     commits) and non-voting learners (learner_mask), with conf changes as
     host-side mask-swap barriers;
   * the linearizable ReadIndex barrier, Safe mode (`read_index` below);
-  * fault injection by per-round crash (isolation) masks — crashed peers
-    keep ticking and campaigning but exchange no messages.
-  Not modeled on device yet (host path handles them): pre-vote,
-  check-quorum (incl. leases), snapshots.
+  * fault injection at LINK granularity (the chaos engine,
+    raft_tpu/multiraft/chaos.py): a directed reachability plane
+    `link[src, dst, g]` threaded through every exchange of the round via
+    `step(..., link=)` — asymmetric partitions, one-way links, seeded
+    per-link message loss, and whole-peer crashes as the special case of
+    a fully-down row+column.  Crash (isolation) masks remain the
+    first-class fast-path input: crashed peers keep ticking and
+    campaigning but exchange no messages, and with `link=None` the
+    traced graph is bit-identical to the pre-chaos build.
+  Not modeled on device (host path handles them): pre-vote, check-quorum
+  (incl. leases) — so one-way partitions inflate terms unboundedly, a
+  pinned behavior (tests/test_chaos_parity.py) — and snapshots; the
+  ReadIndex barrier stays crash-mask-only (not link-aware).
 
 Log model: each peer's log is summarized by (last_index, last_term) plus
 the pairwise agreement plane `agree[a, b]` (common-prefix length).  Logs DO
@@ -262,6 +271,7 @@ def step(
     group_ids: Optional[jnp.ndarray] = None,
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
+    link: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -276,6 +286,15 @@ def step(
                health facts (alive-leader presence, commit advance, term
                bumps, vote splits) are folded into the planes on-device
                (kernels.update_health).
+    link:     optional bool[P, P, G] directed link-reachability plane
+               (link[src, dst, g]): the chaos-engine fault surface.  When
+               given, every message exchange is gated per directed link and
+               the round runs through the pairwise implementation
+               (_linked_step); whole-peer crash is the special case
+               link[p, :, g] = link[:, p, g] = False.  When None (the
+               default) the original all-visible phases below run and the
+               traced graph is bit-identical to the pre-chaos build — the
+               choice is trace-time static, like counters/health.
 
     Extras are appended to the return value in (counters, health) order for
     whichever are given — (state,), (state, counters), (state, health), or
@@ -286,6 +305,10 @@ def step(
     + (propose at leader) + (pump), expressed as masked phases; the election
     phase is skipped wholesale when no peer campaigned this round.
     """
+    if link is not None:
+        return _linked_step(
+            cfg, st, crashed, append_n, link, group_ids, counters, health
+        )
     G, P = cfg.n_groups, cfg.n_peers
     self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
     alive = ~crashed
@@ -761,6 +784,524 @@ def step(
     return (out,) + extras
 
 
+def _linked_step(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    append_n: jnp.ndarray,  # gc: int32[G]
+    link: jnp.ndarray,  # gc: bool[P, P, G]
+    group_ids: Optional[jnp.ndarray] = None,
+    counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
+    health: Optional[HealthState] = None,  # gc: HealthState
+) -> Union[SimState, Tuple]:
+    """The pairwise (link-gated) protocol round behind `step(..., link=)`.
+
+    Every exchange of the round is gated per DIRECTED link: the effective
+    delivery plane is `E[src, dst, g] = link & alive(src) & alive(dst)`
+    (self edges excluded — self-votes and local proposals never cross the
+    network).  Unlike the all-visible fast path, elections can now resolve
+    per partition component (different groups of voters see different
+    candidate sets at different terms), several leaders can replicate to
+    disjoint reachable sets in one round, and one-way links deliver
+    entries without returning acks — so the phases below mirror the scalar
+    pump's wave structure directly:
+
+      wave 1   tick-queued traffic (vote requests + leader heartbeats),
+               processed per receiver in sender-index order — term bumps,
+               grants/rejections, heartbeat commit learning, and the
+               voter-side maybe_commit_by_vote fast-forward;
+      wave 2   responses back over the reverse links: per-candidate joint
+               tallies with the scalar pump's voter-index response order
+               and win/loss cutoffs, candidate-side commit fast-forward;
+      wave 3+  winners' noop broadcasts and heartbeat-triggered catch-up
+               appends, acks over reverse links into per-owner `matched`
+               rows, per-leader quorum commit, and the commit-advance
+               re-broadcast that syncs one-way-reachable members;
+      finally  the round's append workload at the acting leader (the
+               scalar round's propose-then-pump segment).
+
+    Semantics are identical to `step` when every link is up, and to the
+    crash path when `link[p, :, g] = link[:, p, g] = False` mirrors the
+    crash mask — both equivalences are pinned by tests/test_chaos_parity
+    alongside per-round oracle parity (simref.ChaosOracle).
+    """
+    G, P = cfg.n_groups, cfg.n_peers
+    self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
+    p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
+    alive = ~crashed
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    E = link & alive[:, None, :] & alive[None, :, :] & off_diag
+    Erev = jnp.swapaxes(E, 0, 1)  # Erev[s, v, g]: v -> s delivery
+    node_key = _node_key(cfg, group_ids)
+    lo = jnp.full((P, G), cfg.min_timeout, jnp.int32)
+    hi = jnp.full((P, G), cfg.max_timeout, jnp.int32)
+
+    def draw(term):
+        return kernels.timeout_draw(node_key, term.astype(jnp.uint32), lo, hi)
+
+    promotable = st.voter_mask | st.outgoing_mask
+    member = promotable | st.learner_mask
+    ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
+        st.state,
+        st.election_elapsed,
+        st.heartbeat_elapsed,
+        st.randomized_timeout,
+        promotable,
+        cfg.election_tick,
+        cfg.heartbeat_tick,
+    )
+
+    # ---- campaign side effects are local (reference: raft.rs:1101-1117);
+    # isolation cuts the network, never the clock.
+    term = st.term + want_campaign.astype(jnp.int32)
+    state = jnp.where(want_campaign, ROLE_CANDIDATE, st.state)
+    vote = jnp.where(want_campaign, self_id, st.vote)
+    leader_id = jnp.where(want_campaign, 0, st.leader_id)
+    rt = jnp.where(want_campaign, draw(term), st.randomized_timeout)
+
+    req = want_campaign
+    hb_send = want_heartbeat  # tick_kernel gates this on leadership
+
+    # ---- wave 1: tick-queued traffic, per receiver in sender order.  The
+    # running planes (T, V, Ld, ...) play each receiver's sequential
+    # message processing; candidate payloads are the pre-round cursors
+    # (snapshotted at campaign time, before any delivery).
+    T, V, Ld, St = term, vote, leader_id, state
+    EE, HB, RT, C = ee, hb, rt, st.commit
+    grants = []  # per sender: [P_v, G] grant decisions (transient-exact)
+    resps = []  # v responded to s at s's term
+    rej_snap = []  # receiver commit at response time (the reject payload)
+    hb_accs = []  # heartbeat accepted at v (feeds the catch-up trigger)
+    for s in range(P):
+        d = E[s]  # [P_v, G]
+        t_s = term[s][None, :]  # [1, G]
+        # Heartbeat from s — queued at tick time, so it is delivered even
+        # if s itself is deposed later this round (the FIFO interleaving
+        # the all-visible path special-cases; reference: raft.rs:829-839).
+        h_del = d & hb_send[s][None, :] & member
+        h_bump = h_del & (t_s > T)
+        h_acc = h_del & (t_s >= T)  # lower-term heartbeats: silent ignore
+        T = jnp.where(h_bump, t_s, T)
+        V = jnp.where(h_bump, 0, V)
+        St = jnp.where(h_acc, ROLE_FOLLOWER, St)
+        Ld = jnp.where(h_acc, s + 1, Ld)
+        EE = jnp.where(h_acc, 0, EE)
+        HB = jnp.where(h_bump, 0, HB)
+        RT = jnp.where(h_bump, draw(T), RT)
+        hb_val = jnp.minimum(st.matched[s], st.commit[s][None, :])
+        C = jnp.where(h_acc, jnp.maximum(C, hb_val), C)
+        hb_accs.append(h_acc)
+        # Vote request from s (reference: raft.rs:1284-1348 step + the
+        # can_vote check raft.rs:1418-1461 including the leader_id gate).
+        r_del = d & req[s][None, :] & promotable
+        r_bump = r_del & (t_s > T)
+        T = jnp.where(r_bump, t_s, T)
+        V = jnp.where(r_bump, 0, V)
+        Ld = jnp.where(r_bump, 0, Ld)
+        St = jnp.where(r_bump, ROLE_FOLLOWER, St)
+        EE = jnp.where(r_bump, 0, EE)
+        HB = jnp.where(r_bump, 0, HB)
+        RT = jnp.where(r_bump, draw(T), RT)
+        at = r_del & (T == t_s)  # higher-term receivers silently ignore
+        up = (st.last_term[s][None, :] > st.last_term) | (
+            (st.last_term[s][None, :] == st.last_term)
+            & (st.last_index[s][None, :] >= st.last_index)
+        )
+        g = at & (V == 0) & (Ld == 0) & up
+        rej = at & ~g
+        rej_snap.append(C)  # reject responses snapshot commit BEFORE the ff
+        grants.append(g)
+        resps.append(at)
+        # Voter-side maybe_commit_by_vote off the request's commit info
+        # (reference: raft.rs:2126-2164; leaders skip, raft.rs:2131).
+        vff = (
+            rej
+            & (St != ROLE_LEADER)
+            & (st.commit[s][None, :] > C)
+            & (st.commit[s][None, :] <= st.agree[s])
+        )
+        V = jnp.where(g, s + 1, V)
+        EE = jnp.where(g, 0, EE)
+        C = jnp.where(vff, st.commit[s][None, :], C)
+
+    # ---- wave 2: responses travel the reverse links; each candidate
+    # tallies in voter-index order with the scalar cutoffs (a decided
+    # election stops applying rejections — raft.rs:2184-2190 — but the
+    # deciding response itself still fast-forwards, raft.rs:2236-2247).
+    n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+    n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+    q_i = n_i // 2 + 1
+    q_o = n_o // 2 + 1
+    won_rows = []
+    lost_rows = []
+    for ci in range(P):
+        active = req[ci] & (St[ci] == ROLE_CANDIDATE)  # survived wave 1
+        del_g = grants[ci] & Erev[ci]
+        del_r = (resps[ci] & ~grants[ci]) & Erev[ci]
+        agree_ci = st.agree[ci]
+        cnt_i = (active & st.voter_mask[ci]).astype(jnp.int32)  # self-vote
+        cnt_o = (active & st.outgoing_mask[ci]).astype(jnp.int32)
+        rec_i = cnt_i
+        rec_o = cnt_o
+        ff = jnp.zeros((G,), jnp.int32)
+        for v in range(P):
+            won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
+                (cnt_o >= q_o) | (n_o == 0)
+            )
+            lost_before = ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)) | (
+                (n_o > 0) & (cnt_o + (n_o - rec_o) < q_o)
+            )
+            snap = rej_snap[ci][v]
+            ok = (
+                del_r[v]
+                & ~won_before
+                & ~lost_before
+                & (snap <= agree_ci[v])
+            )
+            ff = jnp.where(ok, jnp.maximum(ff, snap), ff)
+            resp_v = del_g[v] | del_r[v]
+            rec_i = rec_i + (resp_v & st.voter_mask[v]).astype(jnp.int32)
+            rec_o = rec_o + (resp_v & st.outgoing_mask[v]).astype(jnp.int32)
+            cnt_i = cnt_i + (del_g[v] & st.voter_mask[v]).astype(jnp.int32)
+            cnt_o = cnt_o + (del_g[v] & st.outgoing_mask[v]).astype(
+                jnp.int32
+            )
+        won_ci = (
+            active
+            & ((cnt_i >= q_i) | (n_i == 0))
+            & ((cnt_o >= q_o) | (n_o == 0))
+        )
+        lost_ci = (
+            active
+            & ~won_ci
+            & (
+                ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i))
+                | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
+            )
+        )
+        won_rows.append(won_ci)
+        lost_rows.append(lost_ci)
+        C = C.at[ci].set(jnp.maximum(C[ci], ff))
+    won = jnp.stack(won_rows)  # [P, G]
+    lost = jnp.stack(lost_rows)
+
+    # Winners become leaders and append their noop (reference:
+    # raft.rs:1151-1202); a crashed/cut-off singleton campaigner wins here
+    # too (self-vote quorum — no solo special case needed).  Losers with a
+    # decided election step down; undecided candidates wait for their next
+    # timeout.
+    li2 = st.last_index + won.astype(jnp.int32)
+    lt2 = jnp.where(won, term, st.last_term)
+    TS = jnp.where(won, li2, st.term_start_index)
+    St = jnp.where(won, ROLE_LEADER, St)
+    Ld = jnp.where(won, self_id, Ld)
+    RT = jnp.where(won | lost, draw(T), RT)
+    EE = jnp.where(won | lost, 0, EE)
+    HB = jnp.where(won, 0, HB)
+    St = jnp.where(lost, ROLE_FOLLOWER, St)
+    eye_pp = jnp.eye(P, dtype=bool)[:, :, None]
+    matched3 = jnp.where(won[:, None, :], 0, st.matched)
+    matched3 = jnp.where(won[:, None, :] & eye_pp, li2[:, None, :], matched3)
+
+    # ---- waves 3+: append deliveries.  Pass 1 = winner noop broadcasts
+    # plus heartbeat-triggered catch-ups (the heartbeat-response path needs
+    # the REVERSE link — it both resumes a paused Progress and reports the
+    # lag; reference: raft.rs:1777-1819).  A delivered, term-accepted
+    # append always resets the receiver's timer and leader_id
+    # (step_follower MsgAppend), but the LOG is adopted only when the probe
+    # matches — the receiver holds the send's prev entry, i.e.
+    # `agree[s, v] >= prev` (index+term identify entries) — or the reverse
+    # link is up, in which case the rejection/decr retry chain converges to
+    # wholesale adoption within the pump.  Acceptance is replayed per
+    # receiver in sender order so transient acks to stale leaders land in
+    # their frozen matched rows exactly like the pump.
+    agree_run = st.agree
+    # Send-time snapshots: a leader deposed mid-wave already queued its
+    # appends with ITS state (heartbeat responses are processed in wave 2,
+    # before any wave-3 append can depose the processor).
+    St2 = St
+    C_send = C
+    acc1 = []
+    resumed = []  # heartbeat response arrived: pr.resume() at the leader
+    for s in range(P):
+        res = hb_accs[s] & Erev[s]
+        resumed.append(res)
+        cu = (
+            res
+            & (st.matched[s] < st.last_index[s][None, :])
+            & (St2[s] == ROLE_LEADER)[None, :]
+        )
+        dmask = E[s] & member & (won[s][None, :] | cu)
+        msg = dmask & (term[s][None, :] >= T)
+        # The winner's noop probe carries prev = its pre-noop cursor (the
+        # fresh-reset Progress is unpaused, so it reaches everyone).
+        adopt = msg & (
+            cu
+            | (agree_run[s] >= st.last_index[s][None, :])
+            | Erev[s]
+        )
+        bump = msg & (term[s][None, :] > T)
+        T = jnp.where(msg, term[s][None, :], T)
+        V = jnp.where(bump, 0, V)
+        St = jnp.where(msg, ROLE_FOLLOWER, St)
+        Ld = jnp.where(msg, s + 1, Ld)
+        EE = jnp.where(msg, 0, EE)
+        RT = jnp.where(bump, draw(T), RT)
+        C = jnp.where(adopt, jnp.maximum(C, C_send[s][None, :]), C)
+        ack = adopt & Erev[s]
+        matched3 = matched3.at[s].set(
+            jnp.where(
+                ack,
+                jnp.maximum(matched3[s], li2[s][None, :]),
+                matched3[s],
+            )
+        )
+        sent_any = jnp.any(adopt, axis=0)  # [G]
+        in_s = adopt | ((p_idx == s) & sent_any[None, :])
+        lead_row = agree_run[s]
+        agree_run = jnp.where(
+            in_s[:, None, :] & in_s[None, :, :],
+            li2[s][None, None, :],
+            jnp.where(
+                in_s[:, None, :],
+                lead_row[None, :, :],
+                jnp.where(in_s[None, :, :], lead_row[:, None, :], agree_run),
+            ),
+        )
+        acc1.append(adopt)
+    LI = li2
+    LT = lt2
+    for s in range(P):
+        LI = jnp.where(acc1[s], li2[s][None, :], LI)
+        LT = jnp.where(acc1[s], lt2[s][None, :], LT)
+
+    # Stage-A quorum commit per leader off the freshly acked matched rows
+    # (the term gate is raft_log.maybe_commit's own-term check).
+    adv = []
+    for s in range(P):
+        mci = jnp.minimum(
+            _quorum_index(matched3[s], st.voter_mask),
+            _quorum_index(matched3[s], st.outgoing_mask),
+        )
+        ok = (
+            (St[s] == ROLE_LEADER)
+            & (mci >= TS[s])
+            & (mci < kernels.INF)
+        )
+        c_new = jnp.where(ok, jnp.maximum(C[s], mci), C[s])
+        adv.append(c_new > C[s])
+        C = C.at[s].set(c_new)
+
+    # Pass 2: a commit advance re-broadcasts appends to every member whose
+    # Progress can still send (bcast_append on maybe_commit; reference:
+    # raft.rs:893-904): Replicate members (acked since this leader's
+    # election — matched > 0) and members whose heartbeat response resumed
+    # a paused probe this round.  The send carries prev = the leader's
+    # current last, so only in-sync members (or reverse-linked ones, via
+    # the retry chain) accept it — a one-way member that missed a send
+    # stays gapped until its reverse link heals.
+    for s in range(P):
+        dmask = (
+            E[s]
+            & member
+            & adv[s][None, :]
+            & ((matched3[s] > 0) | resumed[s])
+        )
+        msg = dmask & (term[s][None, :] >= T)
+        adopt = msg & ((agree_run[s] >= li2[s][None, :]) | Erev[s])
+        bump = msg & (term[s][None, :] > T)
+        T = jnp.where(msg, term[s][None, :], T)
+        V = jnp.where(bump, 0, V)
+        St = jnp.where(msg, ROLE_FOLLOWER, St)
+        Ld = jnp.where(msg, s + 1, Ld)
+        EE = jnp.where(msg, 0, EE)
+        RT = jnp.where(bump, draw(T), RT)
+        LI = jnp.where(adopt, li2[s][None, :], LI)
+        LT = jnp.where(adopt, lt2[s][None, :], LT)
+        a = adopt
+        ack = a & Erev[s]
+        matched3 = matched3.at[s].set(
+            jnp.where(
+                ack,
+                jnp.maximum(matched3[s], li2[s][None, :]),
+                matched3[s],
+            )
+        )
+        sent_any = jnp.any(a, axis=0)
+        in_s = a | ((p_idx == s) & sent_any[None, :])
+        lead_row = agree_run[s]
+        agree_run = jnp.where(
+            in_s[:, None, :] & in_s[None, :, :],
+            li2[s][None, None, :],
+            jnp.where(
+                in_s[:, None, :],
+                lead_row[None, :, :],
+                jnp.where(in_s[None, :, :], lead_row[:, None, :], agree_run),
+            ),
+        )
+    for s in range(P):
+        mci = jnp.minimum(
+            _quorum_index(matched3[s], st.voter_mask),
+            _quorum_index(matched3[s], st.outgoing_mask),
+        )
+        ok = (
+            (St[s] == ROLE_LEADER)
+            & (mci >= TS[s])
+            & (mci < kernels.INF)
+        )
+        c_new = jnp.where(ok, jnp.maximum(C[s], mci), C[s])
+        C = C.at[s].set(c_new)
+        # Commit propagation: if LEADER s's commit advanced past what its
+        # append sends carried, the post-advance broadcast delivers the
+        # settled value — to sendable Progresses only (paused probes miss
+        # it, the same gate as pass 2) and only where the empty append's
+        # probe matches or the reverse link lets the retry chain run.
+        # The leadership gate matters: a stale ex-leader whose commit rose
+        # this round as a RECEIVER broadcasts nothing.
+        elig = (
+            E[s]
+            & member
+            & (St[s] == ROLE_LEADER)[None, :]
+            & (term[s][None, :] >= T)
+            & ((matched3[s] > 0) | resumed[s])
+            & ((agree_run[s] >= li2[s][None, :]) | Erev[s])
+            & (c_new > C_send[s])[None, :]
+        )
+        C = jnp.where(elig, jnp.maximum(C, c_new[None, :]), C)
+
+    # ---- the round's append workload at the acting leader (the scalar
+    # round's propose-then-pump segment, evaluated after the tick pump
+    # quiesces): link-gated port of the all-visible Phase D.
+    is_leader = (St == ROLE_LEADER) & alive
+    has_leader = jnp.any(is_leader, axis=0)
+    lead_term = jnp.max(jnp.where(is_leader, T, -1), axis=0)
+    is_acting = is_leader & (T == lead_term)
+    first_l = jnp.min(jnp.where(is_acting, p_idx, P), axis=0)
+    is_acting_leader = (p_idx == first_l) & has_leader
+    n_app = jnp.where(has_leader, append_n, 0)
+    sent_b = has_leader & (n_app > 0)
+    lead_pre_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
+    LI = LI + jnp.where(is_acting_leader, n_app, 0)
+    LT = jnp.where(is_acting_leader & (n_app > 0), lead_term, LT)
+    lead_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
+    lead_last_term = jnp.max(jnp.where(is_acting_leader, LT, 0), axis=0)
+    reach_b = jnp.any(E & is_acting_leader[:, None, :], axis=0)  # [P_v, G]
+    ack_path = jnp.any(E & is_acting_leader[None, :, :], axis=1)  # v -> l
+    acting_f = is_acting_leader.astype(jnp.int32)
+    acting_row0 = jnp.sum(
+        matched3 * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    resumed_act = jnp.any(
+        jnp.stack(resumed) & is_acting_leader[:, None, :], axis=0
+    )
+    agree_act = jnp.sum(
+        agree_run * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    # The proposal broadcast skips paused probes (no ack since this
+    # leader's election and no resuming heartbeat response this round);
+    # delivered appends reset timers either way, but the log is adopted
+    # only on a probe match or a live reverse link (retry convergence).
+    pr_ok = (acting_row0 > 0) | resumed_act
+    sync_msg = (
+        sent_b
+        & reach_b
+        & member
+        & (T <= lead_term)
+        & ~is_acting_leader
+        & pr_ok
+    )
+    sync_b = sync_msg & ((agree_act >= lead_pre_last[None, :]) | ack_path)
+    bump_b = sync_msg & (T < lead_term)
+    T = jnp.where(sync_msg, lead_term, T)
+    St = jnp.where(sync_msg, ROLE_FOLLOWER, St)
+    V = jnp.where(bump_b, 0, V)
+    Ld = jnp.where(sync_msg, first_l + 1, Ld)
+    EE = jnp.where(sync_msg, 0, EE)
+    RT = jnp.where(bump_b, draw(T), RT)
+    LI = jnp.where(sync_b, lead_last, LI)
+    LT = jnp.where(sync_b, lead_last_term, LT)
+    in_sb = sync_b | (is_acting_leader & sent_b)
+    # dtype= on the masked-row sums: bare jnp.sum widens int32 to int64
+    # under x64, silently turning the planes int64 (GC007).
+    lead_row_b = jnp.sum(
+        agree_run * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    agree_run = jnp.where(
+        in_sb[:, None, :] & in_sb[None, :, :],
+        lead_last[None, None, :],
+        jnp.where(
+            in_sb[:, None, :],
+            lead_row_b[None, :, :],
+            jnp.where(in_sb[None, :, :], lead_row_b[:, None, :], agree_run),
+        ),
+    )
+    acting_row = acting_row0
+    acked_b = (sync_b & ack_path) | (is_acting_leader & sent_b)
+    acting_row = jnp.where(
+        acked_b, jnp.maximum(acting_row, lead_last), acting_row
+    )
+    matched3 = jnp.where(
+        is_acting_leader[:, None, :], acting_row[None, :, :], matched3
+    )
+    ts_acting = jnp.sum(TS * acting_f, axis=0, dtype=jnp.int32)
+    mci_b = jnp.minimum(
+        _quorum_index(acting_row, st.voter_mask),
+        _quorum_index(acting_row, st.outgoing_mask),
+    )
+    commit_ok = sent_b & (mci_b >= ts_acting) & (mci_b < kernels.INF)
+    lead_commit_old = jnp.max(jnp.where(is_acting_leader, C, 0), axis=0)
+    lead_commit = jnp.where(
+        commit_ok, jnp.maximum(lead_commit_old, mci_b), lead_commit_old
+    )
+    C = jnp.where(is_acting_leader, lead_commit, C)
+    C = jnp.where(sync_b, jnp.maximum(C, lead_commit), C)
+
+    out = SimState(
+        term=T,
+        state=St,
+        vote=V,
+        leader_id=Ld,
+        election_elapsed=EE,
+        heartbeat_elapsed=HB,
+        randomized_timeout=RT,
+        last_index=LI,
+        last_term=LT,
+        commit=C,
+        matched=matched3,
+        term_start_index=TS,
+        agree=agree_run,
+        voter_mask=st.voter_mask,
+        outgoing_mask=st.outgoing_mask,
+        learner_mask=st.learner_mask,
+    )
+    if counters is None and health is None:
+        return out
+    won_any = jnp.any(won, axis=0)
+    extras: Tuple = ()
+    if counters is not None:
+        counters = kernels.count_events(
+            counters, want_campaign, want_heartbeat, won_any,
+            out.commit - st.commit,
+        )
+        extras = extras + (counters,)
+    if health is not None:
+        has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        campaigned = jnp.any(want_campaign, axis=0)
+        planes, pos = kernels.update_health(
+            health.planes,
+            health.window_pos,
+            cfg.health_window,
+            has_lead_end,
+            commit_adv,
+            term_bump,
+            campaigned & ~won_any,
+        )
+        extras = extras + (HealthState(planes, pos),)
+    return (out,) + extras
+
+
 def read_index(
     cfg: SimConfig,
     st: SimState,
@@ -836,10 +1377,20 @@ class ClusterSim:
         outgoing_mask: Optional[jnp.ndarray] = None,
         learner_mask: Optional[jnp.ndarray] = None,
         health_monitor=None,
+        chaos=None,
     ):
         self.cfg = cfg
         self.state = init_state(cfg, voter_mask, outgoing_mask, learner_mask)
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
+        # Chaos engine attachment: a chaos.ChaosPlan or chaos.CompiledChaos
+        # (plans compile lazily at this sim's batch shape).  run_plan()
+        # executes it; run_round(link=...) threads ad-hoc link planes.
+        # The lowered schedule and the jitted scan runner are cached per
+        # attached plan so repeated run_plan() calls pay one compile, like
+        # the _step* functions above.
+        self._chaos = chaos
+        self._chaos_compiled = None
+        self._chaos_runner = None
         self._counters: Optional[jnp.ndarray] = None
         self._step_counted = None
         self._health: Optional[HealthState] = None
@@ -874,8 +1425,9 @@ class ClusterSim:
                 1, min(self._DRAIN_MAX, (1 << 31) // (256 * cfg.n_groups))
             )
 
-            def _counted(st, crashed, append_n, ctrs):
-                return step(cfg, st, crashed, append_n, counters=ctrs)
+            def _counted(st, crashed, append_n, ctrs, link=None):
+                return step(cfg, st, crashed, append_n, counters=ctrs,
+                            link=link)
 
             self._step_counted = jax.jit(_counted, donate_argnums=(0, 3))
         if cfg.collect_health:
@@ -893,16 +1445,17 @@ class ClusterSim:
 
             self._summary_fn = jax.jit(_summarize)
 
-            def _healthy(st, crashed, append_n, health):
-                return step(cfg, st, crashed, append_n, health=health)
+            def _healthy(st, crashed, append_n, health, link=None):
+                return step(cfg, st, crashed, append_n, health=health,
+                            link=link)
 
             self._step_health = jax.jit(_healthy, donate_argnums=(0, 3))
             if cfg.collect_counters:
 
-                def _both(st, crashed, append_n, ctrs, health):
+                def _both(st, crashed, append_n, ctrs, health, link=None):
                     return step(
                         cfg, st, crashed, append_n,
-                        counters=ctrs, health=health,
+                        counters=ctrs, health=health, link=link,
                     )
 
                 self._step_both = jax.jit(_both, donate_argnums=(0, 3, 4))
@@ -945,7 +1498,10 @@ class ClusterSim:
             self.health_monitor.record(self._health_summary_dict())
         self._rounds_since_drain = 0
 
-    def run_round(self, crashed=None, append_n=None) -> SimState:
+    def run_round(self, crashed=None, append_n=None, link=None) -> SimState:
+        """One protocol round; `link` (optional bool[P, P, G]) threads the
+        chaos engine's directed reachability plane through the step (see
+        sim.step) — None keeps the original all-visible graph."""
         G, P = self.cfg.n_groups, self.cfg.n_peers
         if crashed is None:
             crashed = jnp.zeros((P, G), bool)
@@ -954,18 +1510,21 @@ class ClusterSim:
         cc, ch = self._counters is not None, self._health is not None
         if cc and ch:
             self.state, self._counters, self._health = self._step_both(
-                self.state, crashed, append_n, self._counters, self._health
+                self.state, crashed, append_n, self._counters, self._health,
+                link,
             )
         elif cc:
             self.state, self._counters = self._step_counted(
-                self.state, crashed, append_n, self._counters
+                self.state, crashed, append_n, self._counters, link
             )
         elif ch:
             self.state, self._health = self._step_health(
-                self.state, crashed, append_n, self._health
+                self.state, crashed, append_n, self._health, link
             )
         else:
-            self.state = self._step(self.state, crashed, append_n)
+            self.state = self._step(
+                self.state, crashed, append_n, None, None, None, link
+            )
             return self.state
         self._rounds_since_drain += 1
         if self._rounds_since_drain >= self._drain_every:
@@ -976,6 +1535,63 @@ class ClusterSim:
         for _ in range(rounds):
             self.run_round(crashed, append_n)
         return self.state
+
+    # --- chaos engine (see raft_tpu/multiraft/chaos.py) ---
+
+    def _chaos_runner_for(self, plan=None):
+        """(CompiledChaos, jitted runner) for `plan` (default: the attached
+        one), cached so repeated run_plan() calls reuse one scan compile."""
+        from . import chaos as chaos_mod
+
+        plan = plan if plan is not None else self._chaos
+        if plan is None:
+            raise RuntimeError(
+                "no chaos plan; construct with chaos= or pass one"
+            )
+        if isinstance(plan, chaos_mod.CompiledChaos):
+            compiled = plan
+        elif plan is self._chaos and self._chaos_compiled is not None:
+            compiled = self._chaos_compiled
+        else:
+            compiled = chaos_mod.compile_plan(plan, self.cfg.n_groups)
+        if plan is self._chaos:
+            if self._chaos_compiled is not compiled:
+                self._chaos_compiled = compiled
+                self._chaos_runner = None
+            if self._chaos_runner is None:
+                self._chaos_runner = chaos_mod.make_runner(
+                    self.cfg, compiled
+                )
+            return compiled, self._chaos_runner
+        return compiled, chaos_mod.make_runner(self.cfg, compiled)
+
+    def run_plan(self, plan=None) -> dict:
+        """Execute the attached (or given) chaos plan as ONE jitted
+        lax.scan — zero host round trips inside the run — and return the
+        scenario report (health.chaos_report: MTTR / time-to-reelect off
+        the health planes, plus the per-round safety-invariant counts).
+
+        Requires SimConfig(collect_health=True): the MTTR stats ride on
+        the HP_LEADERLESS plane.  The sim's state and health planes are
+        advanced in place; the attached plan's compiled schedule and scan
+        are cached, so calling run_plan() repeatedly pays one compile.
+        """
+        from .health import HealthMonitor
+
+        compiled, runner = self._chaos_runner_for(plan)
+        health = self._require_health()
+        self.state, self._health, stats, safety = runner(
+            self.state, health
+        )
+        # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
+        # download of two fixed-size stat vectors, outside the jitted scan.
+        stats_h, safety_h = jax.device_get((stats, safety))
+        report = HealthMonitor.chaos_report(
+            stats_h, safety_h, compiled.n_rounds
+        )
+        if self.health_monitor is not None:
+            self.health_monitor.record_scenario(report)
+        return report
 
     def counters(self) -> dict:
         """Download the device event-counter plane as {name: count}.

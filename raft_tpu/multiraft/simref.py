@@ -72,21 +72,45 @@ class ScalarCluster:
                     Network.new_with_config(ifaces, config)
                 )
 
-    def _apply_crash_mask(self, net: Network, crashed_row: Sequence[bool]) -> None:
+    def _apply_crash_mask(
+        self,
+        net: Network,
+        crashed_row: Sequence[bool],
+        link_row: Optional[np.ndarray] = None,
+    ) -> None:
+        """Install the round's faults as per-edge drops: whole-peer crashes
+        (isolation) plus, when a `link_row[P, P]` reachability matrix is
+        given, a 1.0 drop on every down DIRECTED link — the scalar half of
+        the chaos engine's link plane (sim.step's `link=`)."""
         net.recover()
         for p, c in enumerate(crashed_row):
             if c:
                 net.isolate(p + 1)
+        if link_row is not None:
+            for a in range(self.n_peers):
+                for b in range(self.n_peers):
+                    if a != b and not link_row[a, b]:
+                        net.drop(a + 1, b + 1, 1.0)
 
     def round(self, crashed: Optional[np.ndarray] = None,
-              append_n: Optional[np.ndarray] = None) -> None:
-        """One lockstep protocol round across all groups."""
+              append_n: Optional[np.ndarray] = None,
+              link: Optional[np.ndarray] = None) -> None:
+        """One lockstep protocol round across all groups.
+
+        crashed:  bool[G, P] whole-peer isolation for the round.
+        append_n: int[G] workload proposed at each group's acting leader.
+        link:     optional bool[P, P, G] directed reachability (peer-major
+                  src/dst axes, like the device plane); a down link drops
+                  every message on that edge for the whole round.
+        """
         if crashed is None:
             crashed = np.zeros((self.n_groups, self.n_peers), dtype=bool)
         if append_n is None:
             append_n = np.zeros((self.n_groups,), dtype=np.int64)
         for g, net in enumerate(self.networks):
-            self._apply_crash_mask(net, crashed[g])
+            self._apply_crash_mask(
+                net, crashed[g], None if link is None else link[:, :, g]
+            )
             # Tick every peer in peer order, collecting outbound messages
             # with the pump's persist-before-send discipline.
             initial: List[Message] = []
@@ -194,9 +218,11 @@ class HealthOracle:
                 commit[g, p] = r.raft_log.committed
         return state, term, commit, int(StateRole.Leader)
 
-    def round(self, crashed=None, append_n=None) -> None:
+    def round(self, crashed=None, append_n=None, link=None) -> None:
         """Drive one cluster round and fold its health facts into the
-        planes (the scalar twin of sim.step's health extra)."""
+        planes (the scalar twin of sim.step's health extra).  `link` is
+        the optional bool[P, P, G] chaos reachability plane, passed
+        through to ScalarCluster.round."""
         G, P = self.cluster.n_groups, self.cluster.n_peers
         if crashed is None:
             crashed = np.zeros((G, P), dtype=bool)
@@ -211,7 +237,7 @@ class HealthOracle:
                     and r.election_elapsed + 1 >= r.randomized_election_timeout
                 )
 
-        self.cluster.round(crashed, append_n)
+        self.cluster.round(crashed, append_n, link)
 
         post_state, post_term, post_commit, _ = self._capture()
         alive = ~np.asarray(crashed, dtype=bool)
@@ -238,3 +264,39 @@ class HealthOracle:
             np.int32
         )
         self.window_pos = (self.window_pos + 1) % self.window
+
+
+class ChaosOracle(HealthOracle):
+    """Scalar-side oracle for chaos (link-fault) schedules.
+
+    Replays a compiled fault schedule (chaos.HostSchedule — the numpy twin
+    of the device schedule arrays, including the bit-identical per-round
+    loss draws) through real Raft state machines: each round installs the
+    round's effective link matrix as per-edge 1.0 drops on the harness
+    Network, runs the standard lockstep round, and folds the same health
+    facts as HealthOracle.  tests/test_chaos_parity.py asserts exact
+    per-round equality of every peer's state AND the health planes against
+    ClusterSim stepping the identical schedule through the link-gated
+    device path (sim.step's `link=`).
+
+    This class is the resolved GC010 oracle symbol for the chaos kernels
+    (tools/graftcheck/parity_obligations.json: link_loss_draw /
+    check_safety -> simref.ChaosOracle); renaming it or its entry points
+    is an obligation change and must go through `make obligations`.
+    """
+
+    def __init__(self, cluster: ScalarCluster, schedule=None, window: int = 32):
+        super().__init__(cluster, window=window)
+        self.schedule = schedule
+        self.round_idx = 0
+
+    def scheduled_round(self) -> None:
+        """Advance one round of the attached chaos.HostSchedule."""
+        if self.schedule is None:
+            raise RuntimeError("no schedule attached; pass schedule= or "
+                               "call round(link=...) directly")
+        link, crashed, append = self.schedule.masks(self.round_idx)
+        self.round_idx += 1
+        # Schedule planes are peer-major [P, G]; the scalar round wants
+        # [G, P] crash rows.
+        self.round(crashed=crashed.T, append_n=append, link=link)

@@ -1,0 +1,116 @@
+"""Golden-file tests for the chaos corpus using the datadriven runner.
+
+Each case replays one named plan from tests/testdata/chaos/plans.json
+through ClusterSim's link-gated step (host-materialized schedule masks —
+bit-identical to the device schedule, tests/test_chaos_parity.py) and
+records the end-state health planes, consensus cursors, per-round safety
+counts, and the MTTR facts.  The six scenarios are the corpus the ISSUE
+names: symmetric split, asymmetric link, lossy majority, flapping bridge,
+rolling crash, heal-all.
+
+Every case shares one (G=8, P=3, window=8) ClusterSim — state is reset
+between cases — so the whole file pays for exactly one ~9s link-path jit.
+Regenerate with RAFT_TPU_REWRITE=1."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.datadriven import TestData, run_test, walk
+from raft_tpu.multiraft import ClusterSim, SimConfig
+from raft_tpu.multiraft import chaos, kernels
+from raft_tpu.multiraft import sim as sim_mod
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+G, P, WINDOW = 8, 3, 8
+
+
+class ChaosHarness:
+    def __init__(self):
+        self.cfg = SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, health_window=WINDOW
+        )
+        self.sim = ClusterSim(self.cfg)
+        with open(
+            os.path.join(TESTDATA, "chaos", "plans.json"), encoding="utf-8"
+        ) as f:
+            self.plans = {d["name"]: d for d in json.load(f)}
+
+    def handle(self, td: TestData) -> str:
+        if td.cmd != "run":
+            raise ValueError(f"unknown command {td.cmd}")
+        arg = td.arg("plan")
+        if arg is None:
+            raise ValueError(f"{td.pos}: run needs plan=<name>")
+        plan = chaos.plan_from_dict(self.plans[arg.value])
+        if plan.n_peers != P:
+            raise ValueError(f"{td.pos}: corpus plans must use peers={P}")
+        sched = chaos.HostSchedule(plan, G)
+        sim = self.sim
+        sim.state = sim_mod.init_state(self.cfg)
+        sim.reset_health()
+        safety = np.zeros(kernels.N_SAFETY, np.int64)
+        reelections = healed = 0
+        prev_leaderless = np.zeros(G, np.int64)
+        prev_commit = np.asarray(sim.state.commit)
+        for r in range(plan.n_rounds):
+            link, crashed, append = sched.masks(r)
+            sim.run_round(
+                jnp.asarray(crashed),
+                jnp.asarray(append, dtype=jnp.int32),
+                link=jnp.asarray(link),
+            )
+            st = sim.state
+            safety += np.asarray(
+                kernels.check_safety(
+                    st.state, st.term, st.commit, st.last_index, st.agree,
+                    jnp.asarray(prev_commit),
+                )
+            )
+            prev_commit = np.asarray(st.commit)
+            leaderless = np.asarray(sim._health.planes)[
+                kernels.HP_LEADERLESS
+            ]
+            ended = (prev_leaderless > 0) & (leaderless == 0)
+            reelections += int(ended.sum())
+            healed += int(prev_leaderless[ended].sum())
+            prev_leaderless = leaderless
+        planes = np.asarray(sim._health.planes)
+        st = sim.state
+        out = [
+            f"{name}: {' '.join(str(v) for v in planes[i])}"
+            for i, name in enumerate(kernels.HEALTH_PLANE_NAMES)
+        ]
+        leaders = (np.asarray(st.state) == kernels.ROLE_LEADER).sum(axis=0)
+        out.append("leaders: " + " ".join(str(v) for v in leaders))
+        out.append(
+            "max_term: "
+            + " ".join(str(v) for v in np.asarray(st.term).max(axis=0))
+        )
+        out.append(
+            "commit: "
+            + " ".join(str(v) for v in np.asarray(st.commit).max(axis=0))
+        )
+        out.append(
+            "safety: "
+            + " ".join(
+                f"{k}={v}" for k, v in zip(kernels.SAFETY_NAMES, safety)
+            )
+        )
+        out.append(f"reelections: {reelections} healed_rounds: {healed}")
+        return "\n".join(out)
+
+
+def test_chaos_datadriven():
+    harness = ChaosHarness()  # shared: one link-path jit total
+    ran = []
+
+    def run(path):
+        run_test(path, harness.handle)
+        ran.append(path)
+
+    walk(os.path.join(TESTDATA, "chaos"), run)
+    assert ran

@@ -1,0 +1,552 @@
+"""Chaos-engine parity: the link-fault correctness claims.
+
+Four claims are pinned here (ISSUE 5 acceptance criteria):
+
+  1. chaos-off is free: `sim.step(..., link=None)` traces to the SAME
+     jaxpr as never passing `link` — the fast path's graph is untouched;
+  2. whole-peer crash is the special case
+     `link[p, :, g] = link[:, p, g] = False`: the link path driven with a
+     crash-shaped plane matches the scalar oracle on crash-only schedules;
+  3. per-round state AND health-plane parity of the link-gated device
+     round (sim._linked_step) against simref.ChaosOracle — real Raft
+     state machines behind the harness Network's per-edge drops — across
+     compiled multi-phase schedules with loss and a seeded link fuzz;
+  4. the device loss PRNG (kernels.link_loss_draw) is bit-identical to
+     the numpy twin (chaos.host_loss_draw), so every schedule replays.
+
+Tier-1 cost: the link-path jit is ~9s on CPU, so the tier-1 cases share
+ONE module-scoped ClusterSim (G=8 short schedules); everything at G>=32
+or >=100 rounds is marked slow (the 870s gate is saturated — ROADMAP.md).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.multiraft import (
+    ChaosOracle,
+    ClusterSim,
+    ScalarCluster,
+    SimConfig,
+)
+from raft_tpu.multiraft import chaos, kernels
+from raft_tpu.multiraft import sim as sim_mod
+
+FIELDS = ("term", "state", "commit", "last_index", "last_term")
+
+G, P, WINDOW = 8, 3, 8
+
+
+@pytest.fixture(scope="module")
+def shared_sim():
+    """One ClusterSim — and ONE ~9s link-path compile — for every tier-1
+    case in this file; cases reset its state/health planes."""
+    return ClusterSim(
+        SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, health_window=WINDOW
+        )
+    )
+
+
+def reset(sim):
+    sim.state = sim_mod.init_state(sim.cfg)
+    sim.reset_health()
+    return sim
+
+
+def assert_parity(scalar, sim, r, note=""):
+    want = scalar.snapshot()
+    for f in FIELDS:
+        got = np.asarray(getattr(sim.state, f), dtype=np.int64).T
+        if not np.array_equal(want[f], got):
+            bad = np.argwhere(want[f] != got)[0]
+            raise AssertionError(
+                f"{note} round {r}: {f} mismatch group {bad[0]} peer "
+                f"{bad[1]}: scalar={want[f][bad[0], bad[1]]} "
+                f"device={got[bad[0], bad[1]]}\n"
+                f"scalar row: { {k: v[bad[0]].tolist() for k, v in want.items()} }"
+            )
+
+
+def assert_health_parity(oracle, sim, r, note=""):
+    got = np.asarray(sim._health.planes)
+    if not np.array_equal(got, oracle.planes):
+        bad = np.argwhere(got != oracle.planes)[0]
+        raise AssertionError(
+            f"{note} round {r}: health plane {bad[0]} group {bad[1]}: "
+            f"oracle={oracle.planes[bad[0], bad[1]]} "
+            f"device={got[bad[0], bad[1]]}"
+        )
+
+
+# --- claim 1: the chaos-off graph is bit-identical --------------------------
+
+
+def test_chaos_off_graph_identical():
+    cfg = SimConfig(n_groups=4, n_peers=3)
+    st = sim_mod.init_state(cfg)
+    crashed = jnp.zeros((3, 4), bool)
+    app = jnp.zeros((4,), jnp.int32)
+    base = jax.make_jaxpr(functools.partial(sim_mod.step, cfg))(
+        st, crashed, app
+    )
+    with_none = jax.make_jaxpr(
+        lambda s, c, a: sim_mod.step(cfg, s, c, a, link=None)
+    )(st, crashed, app)
+    assert str(base) == str(with_none)
+
+
+# --- claim 4: the loss PRNG twin is bit-identical ---------------------------
+
+
+def test_loss_draw_matches_host_twin():
+    rng = np.random.RandomState(3)
+    loss = rng.randint(
+        0, kernels.LOSS_SCALE + 1, size=(5, 5, 37)
+    ).astype(np.int32)
+    for r in (0, 1, 7, 1 << 20):
+        dev = np.asarray(kernels.link_loss_draw(jnp.int32(r), jnp.asarray(loss)))
+        host = chaos.host_loss_draw(r, loss)
+        assert np.array_equal(dev, host), f"round {r}"
+    # rate 0 never drops, LOSS_SCALE always drops
+    zero = np.zeros((2, 2, 8), np.int32)
+    assert not np.asarray(kernels.link_loss_draw(jnp.int32(5), jnp.asarray(zero))).any()
+    full = np.full((2, 2, 8), kernels.LOSS_SCALE, np.int32)
+    assert np.asarray(kernels.link_loss_draw(jnp.int32(5), jnp.asarray(full))).all()
+
+
+# --- check_safety unit behavior ---------------------------------------------
+
+
+def test_check_safety_flags_each_invariant():
+    g = 4
+
+    def planes(v):
+        return jnp.full((2, g), v, jnp.int32)
+
+    clean = kernels.check_safety(
+        state=jnp.asarray([[2] * g, [0] * g], jnp.int32),
+        term=planes(3),
+        commit=planes(5),
+        last_index=planes(7),
+        agree=jnp.full((2, 2, g), 6, jnp.int32),
+        prev_commit=planes(5),
+    )
+    assert np.asarray(clean).tolist() == [0, 0, 0, 0]
+    # two leaders in one term
+    dual = kernels.check_safety(
+        state=jnp.asarray([[2] * g, [2] * g], jnp.int32),
+        term=planes(3),
+        commit=planes(5),
+        last_index=planes(7),
+        agree=jnp.full((2, 2, g), 6, jnp.int32),
+        prev_commit=planes(5),
+    )
+    assert int(np.asarray(dual)[kernels.SV_DUAL_LEADER]) == g
+    # committed prefixes disagree: both committed past the common prefix
+    div = kernels.check_safety(
+        state=jnp.zeros((2, g), jnp.int32),
+        term=planes(3),
+        commit=planes(5),
+        last_index=planes(7),
+        agree=jnp.full((2, 2, g), 4, jnp.int32),
+        prev_commit=planes(5),
+    )
+    assert int(np.asarray(div)[kernels.SV_COMMIT_DIVERGED]) == g
+    # commit regression
+    reg = kernels.check_safety(
+        state=jnp.zeros((2, g), jnp.int32),
+        term=planes(3),
+        commit=planes(4),
+        last_index=planes(7),
+        agree=jnp.full((2, 2, g), 6, jnp.int32),
+        prev_commit=planes(5),
+    )
+    assert int(np.asarray(reg)[kernels.SV_COMMIT_REGRESSED]) == g
+    # cursors past the log end
+    bad = kernels.check_safety(
+        state=jnp.zeros((2, g), jnp.int32),
+        term=planes(3),
+        commit=planes(9),
+        last_index=planes(7),
+        agree=jnp.full((2, 2, g), 6, jnp.int32),
+        prev_commit=planes(5),
+    )
+    assert int(np.asarray(bad)[kernels.SV_CURSOR_INVALID]) == g
+
+
+# --- claims 2 + 3, tier-1: shared-sim short schedules -----------------------
+
+
+def golden_plan():
+    """The tier-1 schedule: settle, symmetric split, asymmetric one-way
+    link with loss, heal — every fault class in ~45 rounds."""
+    return chaos.plan_from_dict(
+        {
+            "name": "tier1-mix",
+            "peers": P,
+            "phases": [
+                {"rounds": 16, "append": 1},
+                {"rounds": 10, "partition": [[1, 2], [3]], "append": 1},
+                {
+                    "rounds": 9,
+                    "links": [{"from": 1, "to": 3, "up": False}],
+                    "loss": [{"from": 2, "to": 3, "rate": 0.5}],
+                    "append": 2,
+                },
+                {"rounds": 10, "heal": True, "append": 1},
+            ],
+        }
+    )
+
+
+def test_chaos_parity_scheduled_g8(shared_sim):
+    """Per-round state + health parity against the real scalar pump across
+    the tier-1 multi-phase schedule (partition, one-way link, loss, heal)."""
+    sim = reset(shared_sim)
+    plan = golden_plan()
+    sched = chaos.HostSchedule(plan, G)
+    scalar = ScalarCluster(G, P)
+    oracle = ChaosOracle(scalar, schedule=sched, window=WINDOW)
+    for r in range(plan.n_rounds):
+        link, crashed, append = sched.masks(r)
+        oracle.scheduled_round()
+        sim.run_round(
+            jnp.asarray(crashed),
+            jnp.asarray(append, dtype=jnp.int32),
+            link=jnp.asarray(link),
+        )
+        assert_parity(scalar, sim, r, "scheduled-g8")
+        assert_health_parity(oracle, sim, r, "scheduled-g8")
+
+
+def test_crash_mask_is_link_special_case(shared_sim):
+    """Driving the LINK path with crash-shaped planes (row+column down)
+    reproduces the scalar oracle on a crash-only schedule — whole-peer
+    crash is the promised special case of the link plane."""
+    sim = reset(shared_sim)
+    scalar = ScalarCluster(G, P)
+    oracle = ChaosOracle(scalar, window=WINDOW)
+    crash = np.zeros((G, P), bool)
+    for r in range(40):
+        if r == 18:
+            crash[::2, 0] = True  # even groups lose peer 1
+        if r == 30:
+            crash[:] = False
+        app = np.full(G, 1 if r % 2 else 0, np.int64)
+        link = np.ones((P, P, G), bool)
+        cp = crash.T  # [P, G]
+        link &= ~cp[:, None, :] & ~cp[None, :, :]
+        oracle.round(crash, app)  # crash-mask oracle, no link arg
+        sim.run_round(
+            jnp.asarray(cp.copy()),
+            jnp.asarray(app, dtype=jnp.int32),
+            link=jnp.asarray(link),
+        )
+        assert_parity(scalar, sim, r, "crash-special-case")
+        assert_health_parity(oracle, sim, r, "crash-special-case")
+
+
+def test_asymmetric_partition_term_inflation(shared_sim):
+    """The classic check-quorum-free pathology, pinned: a deposed leader
+    whose INCOMING links are cut (it can send, never receive) re-campaigns
+    forever — every campaign bumps the fleet's term and deposes the
+    sitting leader, so terms inflate and leadership churns without bound.
+    The PR 3 term_bumps_in_window plane is the documented witness: the
+    disturbed groups churn past the threshold, the control groups stay
+    quiet.  (Check-quorum would damp this; it stays host-side —
+    sim.py protocol scope.)"""
+    sim = reset(shared_sim)
+    settle = jnp.ones((G,), jnp.int32)
+    sim.run(30)  # settle leaders with links all-up
+    # Groups 0..3 disturbed: one FOLLOWER per group receives nothing
+    # (column down) but sends everything.  (Cutting the leader's incoming
+    # links instead would only stall commits — a leader never campaigns.)
+    # Groups 4..7 are the control.
+    leader_row = np.argmax(
+        np.asarray(sim.state.state) == kernels.ROLE_LEADER, axis=0
+    )
+    link = np.ones((P, P, G), bool)
+    for g in range(4):
+        link[:, (leader_row[g] + 1) % P, g] = False
+    base_term = np.asarray(sim.state.term).max(axis=0)
+    sim.reset_health()
+    peak_bumps = np.zeros(G, np.int64)
+    jl = jnp.asarray(link)
+    for r in range(80):
+        sim.run_round(append_n=settle, link=jl)
+        peak_bumps = np.maximum(
+            peak_bumps,
+            np.asarray(sim._health.planes)[kernels.HP_TERM_BUMPS],
+        )
+    planes = np.asarray(sim._health.planes)
+    term_now = np.asarray(sim.state.term).max(axis=0)
+    # Disturbed groups inflate terms (one per disturber campaign, i.e.
+    # every randomized timeout in [10, 20)); control groups do not move.
+    assert (term_now[:4] - base_term[:4] >= 3).all(), term_now - base_term
+    assert (term_now[4:] == base_term[4:]).all()
+    # The churn plane is the witness: every disturbed group shows term
+    # bumps inside some churn window, no control group ever does.
+    assert (peak_bumps[:4] >= 1).all(), peak_bumps
+    assert (peak_bumps[4:] == 0).all()
+    # The disturber never wins (no grants return), so every bump is a
+    # vote split — the cumulative split plane records the churn too.
+    splits = planes[kernels.HP_VOTE_SPLITS]
+    assert (splits[:4] >= 3).all(), splits
+    assert (splits[4:] == 0).all()
+
+
+def test_run_plan_matches_stepping_and_is_safe(shared_sim):
+    """One-scan run_plan == round-by-round stepping (same masks, same
+    PRNG), zero safety violations, and the MTTR report is well-formed."""
+    sim = reset(shared_sim)
+    plan = golden_plan()
+    sched = chaos.HostSchedule(plan, G)
+    for r in range(plan.n_rounds):
+        link, crashed, append = sched.masks(r)
+        sim.run_round(
+            jnp.asarray(crashed),
+            jnp.asarray(append, dtype=jnp.int32),
+            link=jnp.asarray(link),
+        )
+    stepped_state = sim.state
+    stepped_planes = np.asarray(sim._health.planes)
+
+    sim2 = ClusterSim(
+        SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, health_window=WINDOW
+        ),
+        chaos=plan,
+    )
+    report = sim2.run_plan()
+    for f in FIELDS + ("matched", "agree", "term_start_index"):
+        assert np.array_equal(
+            np.asarray(getattr(sim2.state, f)),
+            np.asarray(getattr(stepped_state, f)),
+        ), f"run_plan vs stepping: {f}"
+    assert np.array_equal(np.asarray(sim2._health.planes), stepped_planes)
+    assert report["rounds"] == plan.n_rounds
+    assert all(v == 0 for v in report["safety"].values()), report
+    assert report["reelections"] >= 0
+    if report["reelections"]:
+        assert report["mttr_rounds"] > 0
+
+
+# --- claim 3 at scale: seeded link fuzz (slow tier) -------------------------
+
+
+def run_link_fuzz(seed, n_groups, n_peers, rounds, flip=0.08, crashp=0.03):
+    """Random directed link flips + crash flips + periodic heal-all, with
+    exact per-round state and health parity."""
+    scalar = ScalarCluster(n_groups, n_peers)
+    oracle = ChaosOracle(scalar, window=WINDOW)
+    sim = ClusterSim(
+        SimConfig(
+            n_groups=n_groups,
+            n_peers=n_peers,
+            collect_health=True,
+            health_window=WINDOW,
+        )
+    )
+    rng = np.random.RandomState(seed)
+    link = np.ones((n_peers, n_peers, n_groups), bool)
+    crash = np.zeros((n_groups, n_peers), bool)
+    prev_commit = np.asarray(sim.state.commit)
+    for r in range(rounds):
+        for g in range(n_groups):
+            for _ in range(2):
+                if rng.rand() < flip:
+                    a, b = rng.randint(n_peers), rng.randint(n_peers)
+                    if a != b:
+                        link[a, b, g] ^= True
+            if rng.rand() < crashp:
+                crash[g, rng.randint(n_peers)] ^= True
+            if rng.rand() < 0.05:
+                link[:, :, g] = True
+                crash[g, :] = False
+        app = rng.randint(0, 3, size=n_groups).astype(np.int64)
+        oracle.round(crash, app, link)
+        sim.run_round(
+            jnp.asarray(crash.T.copy()),
+            jnp.asarray(app, dtype=jnp.int32),
+            link=jnp.asarray(link.copy()),
+        )
+        assert_parity(scalar, sim, r, f"link-fuzz seed {seed}")
+        assert_health_parity(oracle, sim, r, f"link-fuzz seed {seed}")
+        # The device-side safety invariants must hold on every reachable
+        # state — checked every fuzz round (they caught the stale-leader
+        # commit-broadcast bug the state parity alone missed).
+        st = sim.state
+        counts = np.asarray(
+            kernels.check_safety(
+                st.state, st.term, st.commit, st.last_index, st.agree,
+                jnp.asarray(prev_commit),
+            )
+        )
+        prev_commit = np.asarray(st.commit)
+        assert not counts.any(), (
+            f"link-fuzz seed {seed} round {r}: safety violations "
+            f"{dict(zip(kernels.SAFETY_NAMES, counts.tolist()))}"
+        )
+
+
+@pytest.mark.slow  # ~9s link-path compile per config + lockstep scalar sim
+def test_link_fuzz_plain():
+    for seed in range(4):
+        run_link_fuzz(seed, n_groups=4, n_peers=3, rounds=100)
+
+
+@pytest.mark.slow
+def test_link_fuzz_5peers():
+    for seed in (10, 11):
+        run_link_fuzz(seed, n_groups=3, n_peers=5, rounds=100)
+
+
+@pytest.mark.slow
+def test_link_fuzz_at_scale_g32():
+    """One order of magnitude past the tier-1 batch: cross-group
+    independence of the pairwise planes (the [P, P, G] lanes) gets 32
+    chances per round to break."""
+    run_link_fuzz(3, n_groups=32, n_peers=3, rounds=110, flip=0.05)
+
+
+@pytest.mark.slow
+def test_link_fuzz_joint_and_learners():
+    """Joint double-majority elections and non-voting learners under link
+    faults (the config classes the crash-only fuzz already covers)."""
+    for config, peers, seeds in (
+        ("joint", 5, (0, 1)),
+        ("learners", 4, (0, 1)),
+    ):
+        if config == "joint":
+            voters, outgoing, learners = [1, 2, 3], [3, 4, 5], []
+        else:
+            voters, outgoing, learners = list(range(1, peers)), [], [peers]
+        kwargs = {"voters": voters}
+        if outgoing:
+            kwargs["voters_outgoing"] = outgoing
+        if learners:
+            kwargs["learners"] = learners
+        for seed in seeds:
+            n_groups = 4
+            scalar = ScalarCluster(n_groups, peers, **kwargs)
+            oracle = ChaosOracle(scalar, window=WINDOW)
+            vm = np.zeros((peers, n_groups), bool)
+            om = np.zeros((peers, n_groups), bool)
+            lm = np.zeros((peers, n_groups), bool)
+            for i in voters:
+                vm[i - 1] = True
+            for i in outgoing:
+                om[i - 1] = True
+            for i in learners:
+                lm[i - 1] = True
+            sim = ClusterSim(
+                SimConfig(
+                    n_groups=n_groups,
+                    n_peers=peers,
+                    collect_health=True,
+                    health_window=WINDOW,
+                ),
+                jnp.asarray(vm),
+                jnp.asarray(om),
+                jnp.asarray(lm),
+            )
+            rng = np.random.RandomState(seed)
+            link = np.ones((peers, peers, n_groups), bool)
+            crash = np.zeros((n_groups, peers), bool)
+            for r in range(90):
+                for g in range(n_groups):
+                    for _ in range(2):
+                        if rng.rand() < 0.08:
+                            a, b = rng.randint(peers), rng.randint(peers)
+                            if a != b:
+                                link[a, b, g] ^= True
+                    if rng.rand() < 0.03:
+                        crash[g, rng.randint(peers)] ^= True
+                    if rng.rand() < 0.05:
+                        link[:, :, g] = True
+                        crash[g, :] = False
+                app = rng.randint(0, 3, size=n_groups).astype(np.int64)
+                oracle.round(crash, app, link)
+                sim.run_round(
+                    jnp.asarray(crash.T.copy()),
+                    jnp.asarray(app, dtype=jnp.int32),
+                    link=jnp.asarray(link.copy()),
+                )
+                assert_parity(scalar, sim, r, f"{config} seed {seed}")
+                assert_health_parity(oracle, sim, r, f"{config} seed {seed}")
+
+
+@pytest.mark.slow  # golden corpus at G=32 with the scalar oracle in lockstep
+def test_chaos_golden_corpus_parity_g32():
+    """The six-scenario golden corpus (tests/testdata/chaos) replayed at
+    G=32 with full oracle parity — the datadriven harness pins outputs,
+    this pins the semantics behind them."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "testdata", "chaos", "plans.json"
+    )
+    with open(path, "r", encoding="utf-8") as f:
+        docs = json.load(f)
+    assert len(docs) >= 6
+    for doc in docs:
+        plan = chaos.plan_from_dict(doc)
+        n_groups = 32
+        sched = chaos.HostSchedule(plan, n_groups)
+        scalar = ScalarCluster(n_groups, plan.n_peers)
+        oracle = ChaosOracle(scalar, schedule=sched, window=WINDOW)
+        sim = ClusterSim(
+            SimConfig(
+                n_groups=n_groups,
+                n_peers=plan.n_peers,
+                collect_health=True,
+                health_window=WINDOW,
+            )
+        )
+        for r in range(plan.n_rounds):
+            link, crashed, append = sched.masks(r)
+            oracle.scheduled_round()
+            sim.run_round(
+                jnp.asarray(crashed),
+                jnp.asarray(append, dtype=jnp.int32),
+                link=jnp.asarray(link),
+            )
+            assert_parity(scalar, sim, r, plan.name)
+            assert_health_parity(oracle, sim, r, plan.name)
+
+
+# --- GC010 parity obligations (tools/graftcheck/parity_obligations.json) ---
+
+# Obligations this suite acknowledges owning: the chaos kernels' oracle is
+# the ChaosOracle lockstep driven above (the loss PRNG twin directly, the
+# safety checker on every fuzz/golden round via run_plan).  A new chaos
+# kernel (or a retired one) changes the extracted obligations and fails
+# test_parity_obligations_fresh_and_covered until this set acknowledges it.
+CHAOS_SUITE_OBLIGATIONS = {"link_loss_draw", "check_safety"}
+
+
+def test_parity_obligations_chaos_suite_acknowledged():
+    import json
+    from pathlib import Path
+
+    base = Path(__file__).resolve().parent.parent
+    committed = json.loads(
+        (base / "tools" / "graftcheck" / "parity_obligations.json").read_text(
+            encoding="utf-8"
+        )
+    )
+    mine = {
+        o["kernel"]
+        for o in committed["obligations"]
+        if o["parity_suite"].endswith("test_chaos_parity.py")
+    }
+    assert mine == CHAOS_SUITE_OBLIGATIONS, (
+        "chaos-suite parity obligations changed; extend the schedules (or "
+        "the acknowledgment set) for: "
+        f"{sorted(mine ^ CHAOS_SUITE_OBLIGATIONS)}"
+    )

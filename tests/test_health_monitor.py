@@ -173,8 +173,30 @@ def test_driver_health_disabled_raises():
     d = singleton_driver(G=2)
     with pytest.raises(RuntimeError):
         d.health()
+    with pytest.raises(RuntimeError):
+        d.mttr()
     # explain still works without health (no plane row).
     assert "health" not in d.explain(0)
+
+
+def test_driver_mttr_counts_reelection_episodes():
+    """The host MTTR twin: singleton groups start leaderless, elect once,
+    and every healed episode's length lands in the mean."""
+    d = singleton_driver(G=3, health=HealthConfig(window=8))
+    m0 = d.mttr()
+    assert m0["reelections"] == 0 and m0["mttr_ticks"] is None
+    for _ in range(25):
+        d.tick()
+        pump(d)
+    m1 = d.mttr()
+    # Every group elected itself exactly once (singleton voters).
+    assert m1["reelections"] == 3
+    assert m1["mttr_ticks"] is not None and m1["mttr_ticks"] >= 1
+    assert m1["max_leaderless_streak"] >= 1
+    assert (
+        m1["leaderless_group_ticks"]
+        >= m1["reelections"] * 1
+    )
 
 
 def test_driver_health_with_array_storage():

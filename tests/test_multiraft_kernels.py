@@ -283,3 +283,10 @@ def test_device_plane_dtypes_stay_int32():
     counts, hist, ids, scores = kernels.health_summary(planes, 2, 4, 3, 4)
     for arr in (counts, hist, ids, scores):
         assert arr.dtype == jnp.int32
+
+    # Chaos kernels: the loss sample is bool, the safety counts int32.
+    loss = jnp.zeros((2, 2, 8), jnp.int32)
+    assert kernels.link_loss_draw(jnp.int32(3), loss).dtype == jnp.bool_
+    pg = jnp.zeros((2, 8), jnp.int32)
+    pp = jnp.zeros((2, 2, 8), jnp.int32)
+    assert kernels.check_safety(pg, pg, pg, pg, pp, pg).dtype == jnp.int32
