@@ -32,9 +32,35 @@ Flags (all optional; defaults reproduce the BENCH_r0x methodology):
                   region (pallas_step.fast_multi_round(..., with_health))
                   — the <5% overhead claim of docs/OBSERVABILITY.md.
   --health-out F  write the end-of-run health summary JSON to F.
+  --lossy RATE    chaos-on fused path: thread an all-up link plane with a
+                  uniform per-directed-link loss RATE through
+                  fast_multi_round(..., with_chaos) — in-kernel seeded
+                  loss draws, the instrumented-fleet configuration.  Uses
+                  election_tick=64 so the conservative (lossy) steady
+                  bound leaves headroom for the K=32 fused horizon.
   --groups N      shrink the batch (CI artifact runs; default 100000).
   --reps N        repetition count (>=5 for comparable medians).
   --skip-anchor   skip the native-CPU anchor (vs_baseline becomes null).
+
+Each configuration gets its own metric key so BENCH_r* files distinguish
+which path was measured: the steady path keeps the historical
+`raft_ticks_per_sec_100k_groups_5_peers`, --health appends `_health`,
+--lossy appends `_chaos` (both when combined: `_health_chaos`).
+
+Perf-regression gate (docs/PERF.md):
+
+  --check F        compare this run's median against the committed
+                   baseline F (BENCH_baseline.json), keyed
+                   `metric@backend@gGROUPS`; exits 1 when the median
+                   falls more than the entry's threshold_pct below the
+                   baseline median.  A >20% spread on the current run
+                   (the PR 1 validity flag) downgrades the gate to a
+                   warning — a flagged run cannot assert a regression.
+  --check-out F    also write the gate verdict JSON to F (CI artifact).
+  --check-threshold PCT  override the baseline entry's threshold.
+  --update-baseline      rewrite the baseline entry for this
+                   configuration from this run's stats instead of
+                   checking (commit the result).
 
 Chaos mode (docs/OBSERVABILITY.md "Chaos") replaces the steady bench:
 
@@ -91,66 +117,116 @@ def bench_device(
     health: bool = False,
     profile_dir: str = "",
     health_out: str = "",
+    lossy: float = -1.0,
 ) -> dict:
-    from raft_tpu.multiraft import pallas_step, sim
+    from raft_tpu.multiraft import kernels, pallas_step, sim
     from raft_tpu.multiraft.sim import SimConfig
 
     # CPU runs (the CI artifact job) have no Mosaic lowering: build the
     # pallas kernels in interpret mode — numbers from such a run are NOT
     # comparable to TPU medians.
     interpret = jax.default_backend() == "cpu"
+    chaos = lossy >= 0.0
 
-    cfg = SimConfig(n_groups=groups, n_peers=P)
+    # The chaos-on path dispatches on the CONSERVATIVE steady bound (a
+    # lossy link can drop any heartbeat, so timers are assumed
+    # free-running): the election timeout must clear the fused horizon or
+    # the fused branch would never engage — election_tick=64 > K=32.
+    cfg = SimConfig(
+        n_groups=groups, n_peers=P, election_tick=64 if chaos else 10
+    )
     state = sim.init_state(cfg)
     crashed = jnp.zeros((P, groups), bool)
     append = jnp.ones((groups,), jnp.int32)
+    link = jnp.ones((P, P, groups), bool) if chaos else None
+    loss = (
+        jnp.full((P, P, groups), int(round(lossy * kernels.LOSS_SCALE)),
+                 jnp.int32)
+        if chaos
+        else None
+    )
 
     # Every protocol round executes fully; the fused pallas kernel runs K
     # rounds per VMEM residency when the steady invariant provably holds,
     # with a lax.cond fallback to the general XLA step (bit-identical
     # semantics; see raft_tpu/multiraft/pallas_step.py).  With --health the
     # per-group health planes ride through both branches
-    # (fast_multi_round(..., with_health=True)).
+    # (fast_multi_round(..., with_health=True)); with --lossy both branches
+    # additionally thread the link plane + in-kernel loss draws.
     K = 32
     kstep = pallas_step.fast_multi_round(
-        cfg, k=K, with_health=health, interpret=interpret
+        cfg, k=K, with_health=health, interpret=interpret, with_chaos=chaos
     )
     full = jax.jit(functools.partial(sim.step, cfg))
     hstate = sim.init_health(cfg) if health else None
 
+    def block_step(s, h, rb):
+        """One K-round fused-dispatch block at absolute round rb."""
+        args = (s, crashed, append)
+        if chaos:
+            args = args + (link, loss, rb)
+        if health:
+            out = kstep(*args, h)
+            return out[0], out[1]
+        return kstep(*args), h
+
     if health:
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def multi_round_h(st, h):
-            def body(carry, _):
+        def multi_round_h(st, h, rb):
+            def body(carry, i):
                 s, hh = carry
-                return kstep(s, crashed, append, hh), ()
+                return block_step(s, hh, rb + i * K), ()
 
             carry, _ = jax.lax.scan(
-                body, (st, h), None, length=ROUNDS_PER_SCAN // K
+                body, (st, h),
+                jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32),
             )
             return carry
 
     else:
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def multi_round(st):
-            def body(s, _):
-                return kstep(s, crashed, append), ()
+        def multi_round(st, rb):
+            def body(s, i):
+                return block_step(s, None, rb + i * K)[0], ()
 
-            st, _ = jax.lax.scan(body, st, None, length=ROUNDS_PER_SCAN // K)
+            st, _ = jax.lax.scan(
+                body, st, jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32)
+            )
             return st
 
-    def advance(st, h):
-        if health:
-            return multi_round_h(st, h)
-        return multi_round(st), None
+    round_no = 0
 
-    # Warm up: compile + let the election storm settle into steady state.
-    for _ in range(30):
+    def advance(st, h):
+        nonlocal round_no
+        rb = jnp.int32(round_no)
+        round_no += ROUNDS_PER_SCAN
+        if health:
+            return multi_round_h(st, h, rb)
+        return multi_round(st, rb), None
+
+    # Warm up: compile + let the election storm settle into steady state
+    # (the chaos config's longer election_tick needs a longer settle).
+    settle = 30 if not chaos else 3 * cfg.election_tick
+    for _ in range(settle):
         state = full(state, crashed, append)
+    round_no = settle
     state, hstate = advance(state, hstate)
     jax.block_until_ready(state)
+    if chaos:
+        # Honesty check: the timed region must actually ride the fused
+        # kernel — a rejected predicate would silently bench the general
+        # fallback instead of the chaos-on fast path.
+        pred = bool(
+            pallas_step.steady_predicate(cfg, state, crashed, K, link)
+        )
+        if not pred:
+            print(
+                "WARNING: steady predicate rejects the settled lossy "
+                "state; the chaos bench is timing the general fallback",
+                file=sys.stderr,
+            )
 
     rounds = (ROUNDS_PER_SCAN // K) * K * SCANS
     ticks = groups * rounds
@@ -259,6 +335,101 @@ def bench_scalar_anchor(reps: int = REPS) -> dict:
     return rep_stats(samples)
 
 
+def check_key(metric: str, groups: int) -> str:
+    """Baseline key: one entry per (metric, backend, batch size) — CPU
+    interpret-mode medians and TPU medians must never gate each other."""
+    return f"{metric}@{jax.default_backend()}@g{groups}"
+
+
+def check_against_baseline(
+    line: dict, baseline: dict, threshold_pct=None
+) -> tuple:
+    """The perf-regression gate: (ok, verdict-dict).
+
+    Fails (ok=False) iff the run's median is more than threshold_pct below
+    the committed baseline median.  The PR 1 >20% spread flag is the
+    validity check: a flagged run cannot assert a regression (or a
+    pass) — the gate downgrades to `spread-flagged` and passes so tunnel
+    noise cannot fail CI, exactly like flagged medians are excluded from
+    cross-build comparisons (docs/OBSERVABILITY.md)."""
+    key = check_key(line["metric"], line.get("groups", G))
+    verdict = {"key": key, "median": line["median"]}
+    entry = baseline.get(key)
+    if entry is None:
+        verdict["status"] = "no-baseline"
+        return True, verdict
+    thr = (
+        threshold_pct
+        if threshold_pct is not None
+        else float(entry.get("threshold_pct", 25.0))
+    )
+    floor = float(entry["median"]) * (1.0 - thr / 100.0)
+    verdict.update(
+        baseline_median=entry["median"], threshold_pct=thr,
+        floor=round(floor, 1),
+    )
+    if line.get("spread_flagged"):
+        verdict["status"] = "spread-flagged"
+        return True, verdict
+    if line["median"] < floor:
+        verdict["status"] = "regressed"
+        return False, verdict
+    verdict["status"] = "ok"
+    return True, verdict
+
+
+def run_check(args, line) -> None:
+    """--check / --update-baseline handling; exits 1 on a regression."""
+    import os
+
+    baseline = {}
+    if os.path.exists(args.check):
+        with open(args.check, "r", encoding="utf-8") as f:
+            baseline = json.load(f)
+    key = check_key(line["metric"], line.get("groups", G))
+    if args.update_baseline:
+        if line.get("spread_flagged"):
+            # The gate's own validity rule cuts both ways: a >20%-spread
+            # run cannot assert a pass, a regression, OR a baseline — a
+            # floor set from tunnel noise would wave real regressions by.
+            print(
+                "ERROR: refusing to record a baseline from a "
+                f"spread-flagged run (spread {line['spread_pct']}% > "
+                f"{SPREAD_FLAG_PCT}%); re-run on a quieter host",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        baseline[key] = {
+            "median": line["median"],
+            "threshold_pct": (
+                args.check_threshold
+                if args.check_threshold is not None
+                else baseline.get(key, {}).get("threshold_pct", 25.0)
+            ),
+            "reps": line["reps"],
+            "spread_pct": line["spread_pct"],
+        }
+        with open(args.check, "w", encoding="utf-8") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"baseline updated: {key}", file=sys.stderr)
+        return
+    ok, verdict = check_against_baseline(line, baseline, args.check_threshold)
+    if args.check_out:
+        with open(args.check_out, "w", encoding="utf-8") as f:
+            json.dump(verdict, f)
+    print(f"perf gate: {json.dumps(verdict)}", file=sys.stderr)
+    if not ok:
+        print(
+            f"ERROR: median {line['median']} ticks/sec is below the "
+            f"regression floor {verdict['floor']} "
+            f"(baseline {verdict['baseline_median']} - "
+            f"{verdict['threshold_pct']}%)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+
 def warn_spread(name: str, stats: dict) -> None:
     if stats["spread_flagged"]:
         print(
@@ -272,20 +443,34 @@ def warn_spread(name: str, stats: dict) -> None:
 
 
 def main() -> None:
+    from raft_tpu.platform import enable_compile_cache
+
+    enable_compile_cache()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--profile", default="", metavar="DIR")
     ap.add_argument("--health", action="store_true")
     ap.add_argument("--health-out", default="", metavar="FILE")
+    ap.add_argument("--lossy", type=float, default=-1.0, metavar="RATE")
     ap.add_argument("--groups", type=int, default=G)
     ap.add_argument("--reps", type=int, default=REPS)
     ap.add_argument("--skip-anchor", action="store_true")
     ap.add_argument("--chaos", default="", metavar="PLAN_JSON")
     ap.add_argument("--chaos-out", default="", metavar="FILE")
+    ap.add_argument("--check", default="", metavar="BASELINE_JSON")
+    ap.add_argument("--check-out", default="", metavar="FILE")
+    ap.add_argument("--check-threshold", type=float, default=None)
+    ap.add_argument("--update-baseline", action="store_true")
     args = ap.parse_args()
     if args.health_out and not args.health:
         ap.error("--health-out requires --health")
     if args.chaos_out and not args.chaos:
         ap.error("--chaos-out requires --chaos")
+    if (args.check_out or args.update_baseline) and not args.check:
+        ap.error("--check-out/--update-baseline require --check")
+    if args.lossy > 1.0 or (args.lossy < 0.0 and args.lossy != -1.0):
+        # -1.0 is the chaos-off sentinel; any OTHER negative is a typo
+        # that would silently bench the plain path under the steady key.
+        ap.error("--lossy rate must be in [0, 1]")
 
     if args.chaos:
         chaos_stats = bench_chaos(
@@ -300,6 +485,8 @@ def main() -> None:
             **chaos_stats,
         }
         print(json.dumps(line))
+        if args.check:
+            run_check(args, line)
         return
 
     device = bench_device(
@@ -308,6 +495,7 @@ def main() -> None:
         health=args.health,
         profile_dir=args.profile,
         health_out=args.health_out,
+        lossy=args.lossy,
     )
     anchor = None if args.skip_anchor else bench_scalar_anchor(args.reps)
     # A flagged spread on EITHER side poisons vs_baseline (it is a ratio of
@@ -315,8 +503,15 @@ def main() -> None:
     warn_spread("device", device)
     if anchor is not None:
         warn_spread("native-CPU anchor", anchor)
+    # Per-configuration metric key: steady vs health-on vs chaos-on runs
+    # must never share one baseline series.
+    metric = "raft_ticks_per_sec_100k_groups_5_peers"
+    if args.health:
+        metric += "_health"
+    if args.lossy >= 0.0:
+        metric += "_chaos"
     line = {
-        "metric": "raft_ticks_per_sec_100k_groups_5_peers",
+        "metric": metric,
         "value": device["median"],
         "unit": "ticks/sec",
         "vs_baseline": (
@@ -335,7 +530,11 @@ def main() -> None:
         line["groups"] = args.groups
     if args.health:
         line["health"] = True
+    if args.lossy >= 0.0:
+        line["lossy"] = args.lossy
     print(json.dumps(line))
+    if args.check:
+        run_check(args, line)
 
 
 if __name__ == "__main__":

@@ -178,6 +178,75 @@ def bench_baseline_configs(results, quick):
     if not quick:
         results.append(bench_config4_joint_churn())
         results.append(bench_read_barrier())
+        results.append(bench_fused_instrumented())
+
+
+def bench_fused_instrumented(G=100_000, P=5):
+    """The instrumented fused path (docs/PERF.md): health planes + an
+    all-up link plane with per-link loss threaded through
+    fast_multi_round(with_health, with_chaos) — the production-fleet
+    configuration ISSUE 6 made the fast path.  election_tick=64 so the
+    conservative lossy steady bound clears the k=32 fused horizon."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.multiraft import kernels, pallas_step, sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    cfg = SimConfig(
+        n_groups=G, n_peers=P, election_tick=64, collect_health=True
+    )
+    interpret = jax.default_backend() == "cpu"
+    k = 32
+    kstep = pallas_step.fast_multi_round(
+        cfg, k=k, with_health=True, with_chaos=True, interpret=interpret
+    )
+    st = sim.init_state(cfg)
+    h = sim.init_health(cfg)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    link = jnp.ones((P, P, G), bool)
+    loss = jnp.full(
+        (P, P, G), kernels.LOSS_SCALE // 100, jnp.int32
+    )  # 1% per-link loss
+    step = jax.jit(functools.partial(sim.step, cfg))
+    settle = 3 * cfg.election_tick
+    for _ in range(settle):
+        st = step(st, crashed, append)
+    if not bool(pallas_step.steady_predicate(cfg, st, crashed, k, link)):
+        # Same honesty check as bench.py --lossy: never report a general-
+        # fallback number under the fused-instrumented label.
+        print(
+            "WARNING: steady predicate rejects the settled state; "
+            "config3i is timing the general fallback",
+            file=sys.stderr,
+        )
+
+    blocks = 4
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def multi(st, h, rb):
+        def body(carry, i):
+            s, hh = carry
+            return kstep(s, crashed, append, link, loss, rb + i * k, hh), ()
+
+        return jax.lax.scan(
+            body, (st, h), jnp.arange(blocks, dtype=jnp.int32)
+        )[0]
+
+    st, h = multi(st, h, jnp.int32(settle))
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    st, h = multi(st, h, jnp.int32(settle + blocks * k))
+    jax.block_until_ready(st)
+    dt = time.perf_counter() - t0
+    return (
+        f"config3i: {G // 1000}k x {P} fused health+chaos",
+        G * blocks * k / dt / 1e6,
+        "M ticks/s",
+    )
 
 
 def bench_read_barrier():

@@ -170,18 +170,34 @@ def _rate_to_fp(rate: float) -> int:
 class CompiledChaos(NamedTuple):
     """Device schedule arrays for one plan at one batch shape.
 
-    phase_of_round: int32[R]           round -> phase index
-    link:           bool[NPH, P, P, G] per-phase base link plane
-    loss:           int32[NPH, P, P, G] per-phase loss rates (1/LOSS_SCALE)
-    crashed:        bool[NPH, P, G]    per-phase crash masks
-    append:         int32[NPH, G]      per-phase append workload
+    The bool/sub-int32 planes are stored PACKED (kernels.pack_bits /
+    pack_u16_pairs — GC008 PACKED_PLANES): the per-round schedule gather
+    in the jitted scan reads the packed words from HBM and unpacks them
+    with a handful of VPU shift/mask ops, so the hot loop's schedule
+    traffic shrinks ~6x at P = 5 (byte-per-bool [P, P, G] planes become
+    ceil(P*P/32) uint32 words per group).  schedule_masks returns the
+    planes UNPACKED — the step sees bit-identical masks either way
+    (pinned by tests/test_chaos_parity.py's run_plan-vs-stepping case).
+
+    phase_of_round: int32[R]                round -> phase index
+    link_packed:    uint32[NPH, Wl, G]      per-phase base link plane,
+                                            bit (s*P + d) of the word
+                                            stack (Wl = ceil(P*P/32))
+    loss_packed:    uint32[NPH, Wr, G]      per-phase loss rates
+                                            (1/LOSS_SCALE <= 2**16, two
+                                            halfwords per word, Wr =
+                                            ceil(P*P/2))
+    crashed_packed: uint32[NPH, 1, G]       per-phase crash masks, bit p
+    append:         int32[NPH, G]           per-phase append workload
+    n_peers:        static python int, the unpack shape
     """
 
     phase_of_round: jnp.ndarray  # gc: int32[R]
-    link: jnp.ndarray  # gc: bool[NPH, P, P, G]
-    loss: jnp.ndarray  # gc: int32[NPH, P, P, G]
-    crashed: jnp.ndarray  # gc: bool[NPH, P, G]
+    link_packed: jnp.ndarray  # gc: uint32[NPH, WL, G]
+    loss_packed: jnp.ndarray  # gc: uint32[NPH, WR, G]
+    crashed_packed: jnp.ndarray  # gc: uint32[NPH, 1, G]
     append: jnp.ndarray  # gc: int32[NPH, G]
+    n_peers: int
 
     @property
     def n_rounds(self) -> int:
@@ -243,16 +259,30 @@ def _compile_arrays(
 
 
 def compile_plan(plan: ChaosPlan, n_groups: int) -> CompiledChaos:
-    """Lower a ChaosPlan to device schedule arrays for `n_groups` groups."""
+    """Lower a ChaosPlan to device schedule arrays for `n_groups` groups
+    (bool/loss planes packed — see CompiledChaos)."""
     phase_of_round, link, loss, crashed, append = _compile_arrays(
         plan, n_groups
     )
+    P, G = plan.n_peers, n_groups
+    nph = link.shape[0]
     return CompiledChaos(
         phase_of_round=jnp.asarray(phase_of_round, dtype=jnp.int32),
-        link=jnp.asarray(link, dtype=bool),
-        loss=jnp.asarray(loss, dtype=jnp.int32),
-        crashed=jnp.asarray(crashed, dtype=bool),
+        link_packed=kernels.pack_bits(
+            jnp.asarray(link, dtype=bool).reshape(nph, P * P, G).swapaxes(
+                0, 1
+            )
+        ).swapaxes(0, 1),
+        loss_packed=kernels.pack_u16_pairs(
+            jnp.asarray(loss, dtype=jnp.int32).reshape(nph, P * P, G).swapaxes(
+                0, 1
+            )
+        ).swapaxes(0, 1),
+        crashed_packed=kernels.pack_bits(
+            jnp.asarray(crashed, dtype=bool).swapaxes(0, 1)
+        ).swapaxes(0, 1),
         append=jnp.asarray(append, dtype=jnp.int32),
+        n_peers=P,
     )
 
 
@@ -261,10 +291,20 @@ def schedule_masks(
     round_idx: jnp.ndarray,  # gc: int32[]
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Device-side (link, crashed, append) for one round of the schedule:
-    gather the round's phase row and knock out the seeded loss sample."""
+    gather the round's (packed) phase row, unpack it on device, and knock
+    out the seeded loss sample."""
+    P = compiled.n_peers
+    G = compiled.append.shape[1]
     ph = compiled.phase_of_round[round_idx]
-    drop = kernels.link_loss_draw(round_idx, compiled.loss[ph])
-    return compiled.link[ph] & ~drop, compiled.crashed[ph], compiled.append[ph]
+    link = kernels.unpack_bits(compiled.link_packed[ph], P * P).reshape(
+        P, P, G
+    )
+    loss = kernels.unpack_u16_pairs(compiled.loss_packed[ph], P * P).reshape(
+        P, P, G
+    )
+    crashed = kernels.unpack_bits(compiled.crashed_packed[ph], P)
+    drop = kernels.link_loss_draw(round_idx, loss)
+    return link & ~drop, crashed, compiled.append[ph]
 
 
 # --- host twins (the ChaosOracle side; must stay bit-identical) -----------

@@ -24,6 +24,16 @@ fast path is a pure optimization with IDENTICAL semantics
 (tests/test_pallas_step.py asserts bit-parity round by round; the crashed
 mask and per-round append workload are held constant across the k rounds,
 which is exactly the lockstep schedule ScalarCluster/bench drive).
+
+Coverage matrix (docs/PERF.md): the INSTRUMENTED configurations ride the
+fused path too — `with_health` tracks ticks_since_commit in-kernel and
+folds the other planes closed-form; `with_counters` folds the CTR_* plane
+closed-form (no campaigns/wins on a steady horizon, heartbeat fires and
+commit deltas are arithmetic); `with_chaos` runs the loss-gated chaos
+kernel (_steady_chaos_kernel): link plane healed by predicate, per-link
+loss drawn IN-KERNEL with the (round, src, dst, group) counter PRNG,
+bit-identical to k sequential sim.step(link=) rounds.  The chaos variants
+stream packed sub-int32 operand planes (GC008 PACKED_PLANES registry).
 """
 
 from __future__ import annotations
@@ -36,16 +46,56 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import kernels as kernels_mod
 from . import sim as sim_mod
 from .kernels import (
+    CTR_COMMIT_ENTRIES,
+    CTR_HEARTBEATS,
     HP_SINCE_COMMIT,
     HP_TERM_BUMPS,
     HP_VOTE_SPLITS,
+    ROLE_FOLLOWER,
     ROLE_LEADER,
 )
 from .sim import HealthState, SimConfig, SimState
 
 BLOCK = 8192
+
+
+# --- packed kernel-operand planes (GC008 "packed planes" registry) ----------
+#
+# The fused kernels stream every operand plane HBM -> VMEM once per call, so
+# each plane dropped from the operand list is G*4 bytes of memory traffic
+# saved per fused block.  Three int32 [P, G] planes whose values are provably
+# sub-int32 ride in ONE word each; the bounds are registered in
+# tools/graftcheck/engine/overflow.py (PACKED_PLANES) and derived in
+# docs/STATIC_ANALYSIS.md:
+#
+#   roles word  = state | leader_id << 2 | heartbeat_elapsed << 6
+#                 (state < 4 by the ROLE_* code set; leader_id <= n_peers,
+#                 asserted < 16; heartbeat_elapsed <= heartbeat_tick,
+#                 asserted < 2**24)
+#   masks word  = voter | member << 1 | crashed << 2   (three bools)
+
+
+def _pack_roles(state, leader_id, hb):
+    return state + (leader_id << 2) + (hb << 6)
+
+
+def _unpack_roles(word):
+    return word & 3, (word >> 2) & 15, word >> 6
+
+
+def _pack_masks(voter, member, crashed):
+    return (
+        voter.astype(jnp.int32)
+        + (member.astype(jnp.int32) << 1)
+        + (crashed.astype(jnp.int32) << 2)
+    )
+
+
+def _unpack_masks(word):
+    return (word & 1) != 0, ((word >> 1) & 1) != 0, ((word >> 2) & 1) != 0
 
 
 def _steady_kernel(
@@ -175,25 +225,331 @@ def _steady_kernel(
         refs[n_in + 6][...] = tsc
 
 
+def _quorum_tile(matched, voter, qpos, P):
+    """Majority index of a [P, B] matched tile over its voter rows: the
+    same odd-even transposition network as the plain steady kernel (the
+    in-kernel twin of sim._quorum_index for the non-joint case)."""
+    rows = [
+        jnp.where(voter[p : p + 1, :], matched[p : p + 1, :], 0)
+        for p in range(P)
+    ]
+    for pass_ in range(P):
+        for i in range(pass_ % 2, P - 1, 2):
+            hi = jnp.maximum(rows[i], rows[i + 1])
+            lo = jnp.minimum(rows[i], rows[i + 1])
+            rows[i], rows[i + 1] = hi, lo
+    mci = jnp.zeros_like(rows[0])
+    for p in range(P):
+        mci = jnp.where(qpos == p, rows[p], mci)
+    return mci
+
+
+def _steady_chaos_kernel(
+    # inputs: roles_ref (packed state|leader_id|hb), ee, li, lt, commit,
+    # matched_row, masks_ref (packed voter|member|crashed) [P, B]; agree,
+    # loss_rate [P, P, B]; ts, lead_term, app, round_base [1, B]
+    # [+ tsc when with_health]; outputs: roles, ee, li, lt, commit,
+    # matched_row, agree [+ tsc].
+    *refs,
+    P: int,
+    block: int,
+    rounds: int,
+    election_tick: int,
+    heartbeat_tick: int,
+    with_health: bool,
+):
+    n_in = 14 if with_health else 13
+    (
+        roles_ref, ee_ref, li_ref, lt_ref, commit_ref, matched_ref,
+        masks_ref, agree_ref, loss_ref, ts_ref, ltm_ref, app_ref, rb_ref,
+    ) = refs[:13]
+    (
+        roles_out, ee_out, li_out, lt_out, commit_out, matched_out,
+        agree_out,
+    ) = refs[n_in : n_in + 7]
+    state, leader_id, hb = _unpack_roles(roles_ref[...])
+    voter, member, crashed = _unpack_masks(masks_ref[...])
+    ee = ee_ref[...]
+    li = li_ref[...]
+    lt = lt_ref[...]
+    commit = commit_ref[...]
+    matched_row = matched_ref[...]  # the acting leader's tracker row
+    agree = agree_ref[...]  # [P, P, B] pairwise log agreement
+    loss_rate = loss_ref[...]  # [P, P, B] fixed-point per-link loss
+    ts = ts_ref[...]  # [1, B] acting leader's term_start_index
+    ltm = ltm_ref[...]  # [1, B] acting leader's term
+    app = app_ref[...]  # [1, B]
+    round_base = rb_ref[...]  # [1, B] absolute round index of round 0
+    if with_health:
+        tsc = refs[13][...]
+        maxc_prev = jnp.max(commit, axis=0, keepdims=True)
+
+    alive = ~crashed
+    role_leader = state == ROLE_LEADER
+    is_lead = role_leader & alive  # exactly one per group by the predicate
+    has_leader = jnp.any(is_lead, axis=0, keepdims=True)  # [1, B]
+    lead_f = is_lead.astype(jnp.int32)
+    p_iota = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+    # dtype= on every sum: see _steady_kernel (GC007).
+    lead_id_val = jnp.sum(
+        lead_f * (p_iota + 1), axis=0, keepdims=True, dtype=jnp.int32
+    )
+    count = jnp.sum(voter, axis=0, keepdims=True, dtype=jnp.int32)
+    qpos = count // 2
+    n_app = jnp.where(has_leader, app, 0)  # [1, B]
+    # Global group ids for the (round, src, dst, group)-keyed loss PRNG —
+    # the draw must be bit-identical to kernels.link_loss_draw on the full
+    # batch, so the iota is offset by this tile's first column.
+    gids = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        + pl.program_id(0) * block
+    ).astype(jnp.uint32)
+    s_io = jax.lax.broadcasted_iota(jnp.uint32, (P, P, 1), 0)
+    d_io = jax.lax.broadcasted_iota(jnp.uint32, (P, P, 1), 1)
+    lane = s_io * jnp.uint32(P) + d_io + jnp.uint32(1)
+
+    def lead_gather(plane):  # [P, B] -> [1, B]: the acting leader's value
+        return jnp.sum(plane * lead_f, axis=0, keepdims=True, dtype=jnp.int32)
+
+    def agree_event(agree, in_set, value):
+        """One wholesale-adoption agreement event (sim._linked_step's
+        triple-where): pairs inside `in_set` agree to `value`; pairs with
+        one side inside inherit the leader's row."""
+        lead_row = jnp.sum(
+            agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, B] = agree[leader, :]
+        return jnp.where(
+            in_set[:, None, :] & in_set[None, :, :],
+            value[None, :, :],
+            jnp.where(
+                in_set[:, None, :],
+                lead_row[None, :, :],
+                jnp.where(in_set[None, :, :], lead_row[:, None, :], agree),
+            ),
+        )
+
+    for r in range(rounds):
+        # --- seeded per-link loss draw (kernels.link_loss_draw, inlined
+        # with the tile-global group ids).
+        round_u = (round_base + jnp.int32(r)).astype(jnp.uint32)  # [1, B]
+        x0 = kernels_mod._mix32(gids * jnp.uint32(0x9E3779B1) + round_u)
+        x = kernels_mod._mix32(
+            x0[None, :, :] ^ (lane * jnp.uint32(0x85EBCA6B))
+        )  # [P, P, B]
+        drop = (x % jnp.uint32(kernels_mod.LOSS_SCALE)).astype(
+            jnp.int32
+        ) < loss_rate
+        # Forward (leader -> v) and reverse (v -> leader) delivery for this
+        # round; the link plane itself is all-up among alive peers by the
+        # steady predicate, so only the loss sample gates delivery.
+        dfl = jnp.any(drop & is_lead[:, None, :], axis=0)  # [P, B]
+        dtl = jnp.any(drop & is_lead[None, :, :], axis=1)
+        fwd = ~dfl & alive & ~is_lead
+        rev = ~dtl & alive & ~is_lead
+
+        # --- tick (identical to the plain steady kernel)
+        ee = ee + 1
+        ee = jnp.where(role_leader & (ee >= election_tick), 0, ee)
+        hb = jnp.where(role_leader, hb + 1, hb)
+        want_beat = role_leader & (hb >= heartbeat_tick)
+        hb = jnp.where(want_beat, 0, hb)
+        beat = jnp.any(want_beat & is_lead, axis=0, keepdims=True)  # [1, B]
+
+        # Round-start snapshots of the acting leader's cursors (the
+        # wave payloads are queued before any delivery mutates them).
+        c_l = lead_gather(commit)  # [1, B]
+        li_l = lead_gather(li)
+        lt_l = lead_gather(lt)
+
+        # --- wave 1: heartbeat delivery (terms are all equal, so every
+        # delivered heartbeat is accepted) + the reverse-link response.
+        h_acc = fwd & beat & member
+        state = jnp.where(h_acc, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(h_acc, lead_id_val, leader_id)
+        ee = jnp.where(h_acc, 0, ee)
+        hb_val = jnp.minimum(matched_row, c_l)
+        commit = jnp.where(h_acc, jnp.maximum(commit, hb_val), commit)
+        resumed = h_acc & rev  # pr.resume() at the leader
+
+        # --- wave 3 pass 1: heartbeat-triggered catch-up appends for
+        # lagging members (cu implies both links up, so the send adopts
+        # and the ack lands in the leader's matched row).
+        cu = resumed & (matched_row < li_l)
+        commit = jnp.where(cu, jnp.maximum(commit, c_l), commit)
+        matched_row = jnp.where(
+            cu, jnp.maximum(matched_row, li_l), matched_row
+        )
+        li = jnp.where(cu, li_l, li)
+        lt = jnp.where(cu, lt_l, lt)
+        sent1 = jnp.any(cu, axis=0, keepdims=True)
+        agree = agree_event(agree, cu | (is_lead & sent1), li_l)
+
+        # --- stage-A quorum commit at the leader off the fresh acks.
+        mci = _quorum_tile(matched_row, voter, qpos, P)
+        ok_a = has_leader & (count > 0) & (mci >= ts)
+        c_new = jnp.where(ok_a, jnp.maximum(c_l, mci), c_l)
+        adv = c_new > c_l
+        commit = jnp.where(is_lead, c_new, commit)
+
+        # --- pass 2: a commit advance re-broadcasts to sendable members
+        # (Replicate probes and freshly resumed ones).
+        agree_l = jnp.sum(
+            agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
+        )
+        sendable = (matched_row > 0) | resumed
+        msg2 = fwd & member & adv & sendable
+        adopt2 = msg2 & ((agree_l >= li_l) | rev)
+        state = jnp.where(msg2, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(msg2, lead_id_val, leader_id)
+        ee = jnp.where(msg2, 0, ee)
+        li = jnp.where(adopt2, li_l, li)
+        lt = jnp.where(adopt2, lt_l, lt)
+        matched_row = jnp.where(
+            adopt2 & rev, jnp.maximum(matched_row, li_l), matched_row
+        )
+        agree = agree_event(agree, adopt2 | (is_lead & jnp.any(
+            adopt2, axis=0, keepdims=True)), li_l)
+
+        # --- stage-B commit + the post-advance commit propagation.
+        mci2 = _quorum_tile(matched_row, voter, qpos, P)
+        ok_b = has_leader & (count > 0) & (mci2 >= ts)
+        c_new2 = jnp.where(ok_b, jnp.maximum(c_new, mci2), c_new)
+        commit = jnp.where(is_lead, c_new2, commit)
+        agree_l2 = jnp.sum(
+            agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
+        )
+        sendable2 = (matched_row > 0) | resumed
+        elig = (
+            fwd
+            & member
+            & sendable2
+            & ((agree_l2 >= li_l) | rev)
+            & (c_new2 > c_l)
+        )
+        commit = jnp.where(elig, jnp.maximum(commit, c_new2), commit)
+
+        # --- the round's append workload at the leader.
+        sent_b = has_leader & (n_app > 0)
+        li = li + jnp.where(is_lead, n_app, 0)
+        lt = jnp.where(is_lead & sent_b, ltm, lt)
+        lead_last = li_l + n_app  # [1, B]
+        pr_ok = (matched_row > 0) | resumed
+        sync_msg = sent_b & fwd & member & ~is_lead & pr_ok
+        agree_l3 = jnp.sum(
+            agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
+        )
+        sync_b = sync_msg & ((agree_l3 >= li_l) | rev)
+        state = jnp.where(sync_msg, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(sync_msg, lead_id_val, leader_id)
+        ee = jnp.where(sync_msg, 0, ee)
+        li = jnp.where(sync_b, lead_last, li)
+        lt = jnp.where(sync_b, ltm, lt)
+        acked = (sync_b & rev) | (is_lead & sent_b)
+        matched_row = jnp.where(
+            acked, jnp.maximum(matched_row, lead_last), matched_row
+        )
+        agree = agree_event(agree, sync_b | (is_lead & sent_b), lead_last)
+        mci3 = _quorum_tile(matched_row, voter, qpos, P)
+        ok_c = sent_b & (count > 0) & (mci3 >= ts)
+        lead_commit = jnp.where(ok_c, jnp.maximum(c_new2, mci3), c_new2)
+        commit = jnp.where(is_lead, lead_commit, commit)
+        commit = jnp.where(
+            sync_b, jnp.maximum(commit, lead_commit), commit
+        )
+
+        if with_health:
+            maxc = jnp.max(commit, axis=0, keepdims=True)
+            tsc = jnp.where(maxc > maxc_prev, 0, tsc + 1)
+            maxc_prev = maxc
+
+    roles_out[...] = _pack_roles(state, leader_id, hb)
+    ee_out[...] = ee
+    li_out[...] = li
+    lt_out[...] = lt
+    commit_out[...] = commit
+    matched_out[...] = matched_row
+    agree_out[...] = agree
+    if with_health:
+        refs[n_in + 7][...] = tsc
+
+
+def _fold_counters(cfg: SimConfig, k: int, st_in, st_out, counters):
+    """Closed-form CTR_* fold for a steady k-round horizon: campaigns and
+    elections won are 0 (the predicate forbids both), heartbeat fires per
+    role-leader are (hb0 + k) // heartbeat_tick (the timer resets on every
+    fire), and commit deltas telescope because commit is monotone —
+    bit-identical to threading counters through k sim.steps
+    (tests/test_pallas_step.py)."""
+    role_leader = st_in.state == ROLE_LEADER
+    fires = jnp.where(
+        role_leader,
+        (st_in.heartbeat_elapsed + jnp.int32(k))
+        // jnp.int32(cfg.heartbeat_tick),
+        0,
+    )
+    # dtype= on the sums: a bare jnp.sum widens to int64 under x64 (GC007).
+    hb_total = jnp.sum(fires, dtype=jnp.int32)
+    commit_total = jnp.sum(st_out.commit - st_in.commit, dtype=jnp.int32)
+    return (
+        counters.at[CTR_HEARTBEATS]
+        .add(hb_total)
+        .at[CTR_COMMIT_ENTRIES]
+        .add(commit_total)
+    )
+
+
+def _steady_health_fold(cfg: SimConfig, rounds: int, health, tsc_out):
+    """Closed-form health fold for a steady horizon: the churn window
+    resets iff a round with window_pos == 0 falls inside [pos, pos +
+    rounds), and every in-horizon bump is 0."""
+    pos = health.window_pos
+    window = jnp.int32(cfg.health_window)
+    crossed = (pos == 0) | (pos + jnp.int32(rounds) > window)
+    planes = jnp.stack(
+        [
+            jnp.zeros_like(tsc_out),  # leaderless: a leader held all k
+            tsc_out,
+            jnp.where(crossed, 0, health.planes[HP_TERM_BUMPS]),
+            health.planes[HP_VOTE_SPLITS],
+        ]
+    )
+    new_pos = (pos + jnp.int32(rounds)) % window
+    return HealthState(planes, new_pos)
+
+
 def steady_round(
     cfg: SimConfig,
     rounds: int = 1,
     with_health: bool = False,
     interpret: bool = False,
+    with_chaos: bool = False,
+    with_counters: bool = False,
 ):
     """Build the pallas_call for `rounds` fused steady protocol rounds;
     returns fn(st, crashed, append_n) -> SimState (same crashed/append each
     round).
 
-    With `with_health`, the returned fn is fn(st, crashed, append_n,
-    health) -> (SimState, HealthState), bit-identical to threading
-    sim.step's health extra through the same rounds.  Only
-    ticks_since_commit needs per-round tracking (one extra [1, BLOCK] VMEM
-    plane); the other planes are closed-form over a steady horizon — no
-    campaigns can fire and the alive leader holds, so leaderless_ticks
-    lands at 0, vote_splits is unchanged, term bumps are 0 and the churn
-    window only needs its position advanced (with one reset if a window
-    boundary falls inside the horizon)."""
+    With `with_health`, the returned fn takes a HealthState extra and
+    returns it updated, bit-identical to threading sim.step's health extra
+    through the same rounds.  Only ticks_since_commit needs per-round
+    tracking (one extra [1, BLOCK] VMEM plane); the other planes are
+    closed-form over a steady horizon — no campaigns can fire and the
+    alive leader holds, so leaderless_ticks lands at 0, vote_splits is
+    unchanged, term bumps are 0 and the churn window only needs its
+    position advanced (with one reset if a window boundary falls inside
+    the horizon).
+
+    With `with_counters`, the fn takes/returns the [N_COUNTERS] int32
+    plane; the per-round event counts are closed-form over a steady
+    horizon (_fold_counters).
+
+    With `with_chaos`, the fn signature grows (loss_rate int32[P, P, G],
+    round_base int32[]) after append_n and the round runs the loss-gated
+    chaos kernel (_steady_chaos_kernel): per-link loss draws are sampled
+    in-kernel with the (round, src, dst, group) counter PRNG, bit-identical
+    to `rounds` sequential sim.step(link=healed & ~loss_draw) calls.  The
+    extras order is always (loss, round_base), counters, health —
+    sim.step's extras convention."""
     P = cfg.n_peers
     G = cfg.n_groups
     block = min(BLOCK, G)
@@ -201,6 +557,12 @@ def steady_round(
 
     pg_spec = pl.BlockSpec((P, block), lambda i: (0, i), memory_space=pltpu.VMEM)
     g_spec = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    if with_chaos:
+        return _build_chaos_round(
+            cfg, rounds, with_health, with_counters, interpret,
+            pg_spec, g_spec, grid, block,
+        )
 
     kernel = functools.partial(
         _steady_kernel,
@@ -320,32 +682,184 @@ def steady_round(
         out, tsc_out = _run(
             st, crashed, append_n, health.planes[HP_SINCE_COMMIT]
         )
-        # Closed-form health fold for a steady horizon (see the docstring):
-        # the churn window resets iff a round with window_pos == 0 falls
-        # inside [pos, pos + rounds), and every in-horizon bump is 0.
-        pos = health.window_pos
-        window = jnp.int32(cfg.health_window)
-        crossed = (pos == 0) | (pos + jnp.int32(rounds) > window)
-        planes = jnp.stack(
-            [
-                jnp.zeros_like(tsc_out),  # leaderless: a leader held all k
-                tsc_out,
-                jnp.where(crossed, 0, health.planes[HP_TERM_BUMPS]),
-                health.planes[HP_VOTE_SPLITS],
-            ]
-        )
-        new_pos = (pos + jnp.int32(rounds)) % window
-        return out, HealthState(planes, new_pos)
+        # Closed-form health fold for a steady horizon (see the docstring).
+        return out, _steady_health_fold(cfg, rounds, health, tsc_out)
 
-    return fn_health if with_health else fn
+    if not with_counters:
+        return fn_health if with_health else fn
+
+    # Counters ride the fused path as a closed-form fold around either
+    # variant above (extras order: counters before health, like sim.step).
+    if with_health:
+
+        def fn_counted_health(st, crashed, append_n, counters, health):
+            out, health2 = fn_health(st, crashed, append_n, health)
+            return out, _fold_counters(cfg, rounds, st, out, counters), health2
+
+        return fn_counted_health
+
+    def fn_counted(st, crashed, append_n, counters):
+        out = fn(st, crashed, append_n)
+        return out, _fold_counters(cfg, rounds, st, out, counters)
+
+    return fn_counted
+
+
+def _build_chaos_round(
+    cfg: SimConfig,
+    rounds: int,
+    with_health: bool,
+    with_counters: bool,
+    interpret: bool,
+    pg_spec,
+    g_spec,
+    grid,
+    block: int,
+):
+    """The chaos-on (loss-gated) fused steady round: see steady_round's
+    docstring.  Separate builder so the chaos machinery cannot perturb the
+    plain kernel's traced graph (pinned by jaxpr equality in
+    tests/test_pallas_step.py)."""
+    P = cfg.n_peers
+    G = cfg.n_groups
+    # The packed roles word budgets 4 bits for leader_id and the rest for
+    # heartbeat_elapsed (bound: <= heartbeat_tick) — see the PACKED_PLANES
+    # registry (tools/graftcheck/engine/overflow.py).
+    assert P <= 15, "packed roles word budgets 4 bits for leader_id"
+    assert cfg.heartbeat_tick < (1 << 24), (
+        "packed roles word budgets 24 bits for heartbeat_elapsed"
+    )
+    ppg_spec = pl.BlockSpec(
+        (P, P, block), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _steady_chaos_kernel,
+        P=P,
+        block=block,
+        rounds=rounds,
+        election_tick=cfg.election_tick,
+        heartbeat_tick=cfg.heartbeat_tick,
+        with_health=with_health,
+    )
+    n_g_in = 5 if with_health else 4
+    out_shape = [jax.ShapeDtypeStruct((P, G), jnp.int32)] * 6 + [
+        jax.ShapeDtypeStruct((P, P, G), jnp.int32)
+    ]
+    out_specs = [pg_spec] * 6 + [ppg_spec]
+    if with_health:
+        out_shape = out_shape + [jax.ShapeDtypeStruct((1, G), jnp.int32)]
+        out_specs = out_specs + [g_spec]
+    interp_kw = {"interpret": True} if interpret else {}
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pg_spec] * 7 + [ppg_spec] * 2 + [g_spec] * n_g_in,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        **interp_kw,
+    )
+
+    def _run(
+        st: SimState,
+        crashed: jnp.ndarray,
+        append_n: jnp.ndarray,
+        loss_rate: jnp.ndarray,
+        round_base: jnp.ndarray,
+        tsc_in: Optional[jnp.ndarray],
+    ):
+        is_leader = (st.state == ROLE_LEADER) & ~crashed
+        f = is_leader.astype(jnp.int32)
+        # dtype= keeps the gathered rows int32 under x64 (GC007).
+        acting_row = jnp.sum(
+            st.matched * f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, G]
+        ts_acting = jnp.sum(
+            st.term_start_index * f, axis=0, dtype=jnp.int32
+        )  # [G]
+        lead_term = jnp.sum(st.term * f, axis=0, dtype=jnp.int32)  # [G]
+        member = st.voter_mask | st.learner_mask
+        rb = jnp.broadcast_to(
+            jnp.reshape(round_base.astype(jnp.int32), (1, 1)), (1, G)
+        )
+        inputs = (
+            _pack_roles(st.state, st.leader_id, st.heartbeat_elapsed),
+            st.election_elapsed,
+            st.last_index,
+            st.last_term,
+            st.commit,
+            acting_row,
+            _pack_masks(st.voter_mask, member, crashed),
+            st.agree,
+            loss_rate,
+            ts_acting[None, :],
+            lead_term[None, :],
+            append_n[None, :],
+            rb,
+        )
+        if tsc_in is not None:
+            inputs = inputs + (tsc_in[None, :],)
+        outs = call(*inputs)
+        roles, ee, li, lt, commit, new_row, agree = outs[:7]
+        tsc_out = outs[7][0] if tsc_in is not None else None
+        state, leader_id, hb = _unpack_roles(roles)
+        matched = jnp.where(
+            is_leader[:, None, :], new_row[None, :, :], st.matched
+        )
+        out = st._replace(
+            state=state,
+            leader_id=leader_id,
+            election_elapsed=ee,
+            heartbeat_elapsed=hb,
+            last_index=li,
+            last_term=lt,
+            matched=matched,
+            commit=commit,
+            agree=agree,
+        )
+        return out, tsc_out
+
+    # Static extras layout, resolved at build time (counters before health,
+    # sim.step's extras order); None = absent.
+    idx_counters = 0 if with_counters else None
+    idx_health = (1 if with_counters else 0) if with_health else None
+
+    def fn(st, crashed, append_n, loss_rate, round_base, *extras):
+        counters = None if idx_counters is None else extras[idx_counters]
+        health = None if idx_health is None else extras[idx_health]
+        tsc_in = None if health is None else health.planes[HP_SINCE_COMMIT]
+        out, tsc_out = _run(
+            st, crashed, append_n, loss_rate, round_base, tsc_in
+        )
+        res: tuple = (out,)
+        if counters is not None:
+            res = res + (_fold_counters(cfg, rounds, st, out, counters),)
+        if health is not None:
+            res = res + (_steady_health_fold(cfg, rounds, health, tsc_out),)
+        if idx_counters is None and idx_health is None:
+            return out
+        return res
+
+    return fn
 
 
 def steady_mask(
-    cfg: SimConfig, st: SimState, crashed: jnp.ndarray, horizon: int = 1
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,
+    horizon: int = 1,
+    link: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """bool[G]: per-group steady invariant for the next `horizon` rounds —
     no election timer can fire, exactly one alive leader, every alive peer
-    already at the leader's term, not in joint config."""
+    already at the leader's term, not in joint config.
+
+    With `link` (the chaos engine's bool[P, P, G] reachability plane) the
+    invariant additionally requires every directed link among alive peers
+    to be up (a fully-healed plane always satisfies this), and the
+    election-timer bound falls back to the fully conservative free-running
+    form: per-link LOSS may drop any heartbeat, so the per-round re-sync
+    that lets the heartbeat_tick == 1 fast bound assume ee -> 0 cannot be
+    relied on."""
     alive = ~crashed
     # 1. nobody can campaign within the horizon.  With heartbeat_tick == 1
     # an alive follower under a live leader is re-synced (ee -> 0) every
@@ -353,7 +867,7 @@ def steady_mask(
     # timers run free for the whole horizon.  For larger heartbeat ticks we
     # fall back to the fully conservative free-running bound.
     non_leader_voter = (st.state != ROLE_LEADER) & st.voter_mask
-    if cfg.heartbeat_tick == 1:
+    if cfg.heartbeat_tick == 1 and link is None:
         may_fire = non_leader_voter & (
             jnp.where(
                 alive,
@@ -379,15 +893,29 @@ def steady_mask(
     # 4. not joint (the fused kernel computes the single-majority quorum;
     # joint groups take the general XLA path)
     not_joint = ~jnp.any(st.outgoing_mask, axis=0)
-    return no_campaign & one_leader & terms_ok & not_joint
+    ok = no_campaign & one_leader & terms_ok & not_joint
+    if link is not None:
+        # 5. every directed link among alive peers is up (crashed peers'
+        # links and self-links are dead weight either way).
+        eye = jnp.eye(cfg.n_peers, dtype=bool)[:, :, None]
+        links_ok = jnp.all(
+            link | eye | crashed[:, None, :] | crashed[None, :, :],
+            axis=(0, 1),
+        )
+        ok = ok & links_ok
+    return ok
 
 
 def steady_predicate(
-    cfg: SimConfig, st: SimState, crashed: jnp.ndarray, horizon: int = 1
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,
+    horizon: int = 1,
+    link: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """True iff EVERY group satisfies the steady invariant (see
     steady_mask)."""
-    return jnp.all(steady_mask(cfg, st, crashed, horizon))
+    return jnp.all(steady_mask(cfg, st, crashed, horizon, link))
 
 
 def fast_step(cfg: SimConfig, with_health: bool = False):
@@ -428,6 +956,8 @@ def fast_multi_round(
     k: int = 16,
     with_health: bool = False,
     interpret: bool = False,
+    with_chaos: bool = False,
+    with_counters: bool = False,
 ):
     """Dispatcher advancing k protocol rounds per call (same crashed/append
     every round): the k-fused pallas kernel when provably steady for the
@@ -437,10 +967,88 @@ def fast_multi_round(
     With `with_health`, fn(st, crashed, append_n, health) -> (SimState,
     HealthState): both branches thread the health planes, so per-round
     health parity holds whichever branch runs (tests/test_pallas_step.py).
-    """
+
+    With `with_counters`, the fn threads the [N_COUNTERS] int32 plane the
+    same way (extras order counters-then-health, like sim.step).
+
+    With `with_chaos`, fn(st, crashed, append_n, link, loss_rate,
+    round_base, *extras): the link plane and per-link loss rates are the
+    chaos engine's fault surface, round_base the absolute round index of
+    the first of the k rounds (the loss PRNG replay key).  The fused
+    kernel runs when the steady invariant holds AND the link plane is
+    fully healed among alive peers (loss is folded in-kernel); otherwise k
+    sequential sim.step(link=link & ~loss_draw) rounds run — bit-identical
+    either way (tests/test_pallas_step.py)."""
     pallas_fn = steady_round(
-        cfg, rounds=k, with_health=with_health, interpret=interpret
+        cfg,
+        rounds=k,
+        with_health=with_health,
+        interpret=interpret,
+        with_chaos=with_chaos,
+        with_counters=with_counters,
     )
+
+    if with_chaos or with_counters:
+        n_extra = (1 if with_counters else 0) + (1 if with_health else 0)
+        # Static arg layout, resolved at build time: args[3:6] are
+        # (link, loss, round_base) when chaos is on; extras follow.
+        extras_at = 6 if with_chaos else 3
+        chaos_at = 3 if with_chaos else None
+        idx_counters = 0 if with_counters else None
+        idx_health = (1 if with_counters else 0) if with_health else None
+
+        def slow_general(args):
+            st, crashed, append_n = args[:3]
+            link = loss = round_base = None
+            if chaos_at is not None:
+                link, loss, round_base = args[chaos_at : chaos_at + 3]
+            extras = args[extras_at:]
+
+            def body(carry, r):
+                s, *ex = carry
+                kw = {}
+                if idx_counters is not None:
+                    kw["counters"] = ex[idx_counters]
+                if idx_health is not None:
+                    kw["health"] = ex[idx_health]
+                if link is not None:
+                    kw["link"] = link & ~kernels_mod.link_loss_draw(
+                        round_base + r, loss
+                    )
+                res = sim_mod.step(cfg, s, crashed, append_n, **kw)
+                # NB: SimState is itself a NamedTuple, so the bare-state
+                # return must be wrapped by flag, not isinstance.
+                if idx_counters is None and idx_health is None:
+                    res = (res,)
+                return tuple(res), ()
+
+            carry, _ = jax.lax.scan(
+                body,
+                (st,) + tuple(extras),
+                jnp.arange(k, dtype=jnp.int32),
+            )
+            return carry if n_extra else carry[0]
+
+        def fast(args):
+            st, crashed, append_n = args[:3]
+            if chaos_at is None:
+                return pallas_fn(st, crashed, append_n, *args[3:])
+            loss, round_base = args[4], args[5]
+            return pallas_fn(
+                st, crashed, append_n, loss, round_base, *args[6:]
+            )
+
+        def fn_general(st, crashed, append_n, *rest):
+            link = rest[0] if chaos_at is not None else None
+            pred = steady_predicate(cfg, st, crashed, horizon=k, link=link)
+            return jax.lax.cond(
+                pred,
+                fast,
+                slow_general,
+                (st, crashed, append_n) + tuple(rest),
+            )
+
+        return fn_general
 
     if with_health:
 

@@ -865,35 +865,37 @@ def _linked_step(
     # ---- wave 1: tick-queued traffic, per receiver in sender order.  The
     # running planes (T, V, Ld, ...) play each receiver's sequential
     # message processing; candidate payloads are the pre-round cursors
-    # (snapshotted at campaign time, before any delivery).
-    T, V, Ld, St = term, vote, leader_id, state
-    EE, HB, RT, C = ee, hb, rt, st.commit
-    grants = []  # per sender: [P_v, G] grant decisions (transient-exact)
-    resps = []  # v responded to s at s's term
-    rej_snap = []  # receiver commit at response time (the reject payload)
-    hb_accs = []  # heartbeat accepted at v (feeds the catch-up trigger)
-    for s in range(P):
-        d = E[s]  # [P_v, G]
-        t_s = term[s][None, :]  # [1, G]
+    # (snapshotted at campaign time, before any delivery).  Each wave's
+    # sender loop is a lax.scan over the (stacked) per-sender rows rather
+    # than an unrolled python loop: the per-sender body traces ONCE, which
+    # cuts the link-path jaxpr (and its multi-second XLA compile) by ~P×
+    # while executing the identical op sequence — chaos parity stays
+    # bit-exact (tests/test_chaos_parity.py).
+    sender_ids = jnp.arange(P, dtype=jnp.int32)  # scan xs: the sender index
+
+    def _wave1_body(carry, xs):
+        T, V, Ld, St, EE, HB, RT, C = carry
+        (d, hb_s, req_s, t_row, m_row, c_row, lt_row, li_row, agree_row,
+         sid) = xs
+        t_s = t_row[None, :]  # [1, G]
         # Heartbeat from s — queued at tick time, so it is delivered even
         # if s itself is deposed later this round (the FIFO interleaving
         # the all-visible path special-cases; reference: raft.rs:829-839).
-        h_del = d & hb_send[s][None, :] & member
+        h_del = d & hb_s[None, :] & member
         h_bump = h_del & (t_s > T)
         h_acc = h_del & (t_s >= T)  # lower-term heartbeats: silent ignore
         T = jnp.where(h_bump, t_s, T)
         V = jnp.where(h_bump, 0, V)
         St = jnp.where(h_acc, ROLE_FOLLOWER, St)
-        Ld = jnp.where(h_acc, s + 1, Ld)
+        Ld = jnp.where(h_acc, sid + 1, Ld)
         EE = jnp.where(h_acc, 0, EE)
         HB = jnp.where(h_bump, 0, HB)
         RT = jnp.where(h_bump, draw(T), RT)
-        hb_val = jnp.minimum(st.matched[s], st.commit[s][None, :])
+        hb_val = jnp.minimum(m_row, c_row[None, :])
         C = jnp.where(h_acc, jnp.maximum(C, hb_val), C)
-        hb_accs.append(h_acc)
         # Vote request from s (reference: raft.rs:1284-1348 step + the
         # can_vote check raft.rs:1418-1461 including the leader_id gate).
-        r_del = d & req[s][None, :] & promotable
+        r_del = d & req_s[None, :] & promotable
         r_bump = r_del & (t_s > T)
         T = jnp.where(r_bump, t_s, T)
         V = jnp.where(r_bump, 0, V)
@@ -903,26 +905,36 @@ def _linked_step(
         HB = jnp.where(r_bump, 0, HB)
         RT = jnp.where(r_bump, draw(T), RT)
         at = r_del & (T == t_s)  # higher-term receivers silently ignore
-        up = (st.last_term[s][None, :] > st.last_term) | (
-            (st.last_term[s][None, :] == st.last_term)
-            & (st.last_index[s][None, :] >= st.last_index)
+        up = (lt_row[None, :] > st.last_term) | (
+            (lt_row[None, :] == st.last_term)
+            & (li_row[None, :] >= st.last_index)
         )
         g = at & (V == 0) & (Ld == 0) & up
         rej = at & ~g
-        rej_snap.append(C)  # reject responses snapshot commit BEFORE the ff
-        grants.append(g)
-        resps.append(at)
+        snap = C  # reject responses snapshot commit BEFORE the ff
         # Voter-side maybe_commit_by_vote off the request's commit info
         # (reference: raft.rs:2126-2164; leaders skip, raft.rs:2131).
         vff = (
             rej
             & (St != ROLE_LEADER)
-            & (st.commit[s][None, :] > C)
-            & (st.commit[s][None, :] <= st.agree[s])
+            & (c_row[None, :] > C)
+            & (c_row[None, :] <= agree_row)
         )
-        V = jnp.where(g, s + 1, V)
+        V = jnp.where(g, sid + 1, V)
         EE = jnp.where(g, 0, EE)
-        C = jnp.where(vff, st.commit[s][None, :], C)
+        C = jnp.where(vff, c_row[None, :], C)
+        return (T, V, Ld, St, EE, HB, RT, C), (g, at, snap, h_acc)
+
+    (T, V, Ld, St, EE, HB, RT, C), (grants, resps, rej_snap, hb_accs) = (
+        jax.lax.scan(
+            _wave1_body,
+            (term, vote, leader_id, state, ee, hb, rt, st.commit),
+            (
+                E, hb_send, req, term, st.matched, st.commit, st.last_term,
+                st.last_index, st.agree, sender_ids,
+            ),
+        )
+    )
 
     # ---- wave 2: responses travel the reverse links; each candidate
     # tallies in voter-index order with the scalar cutoffs (a decided
@@ -932,40 +944,41 @@ def _linked_step(
     n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
     q_i = n_i // 2 + 1
     q_o = n_o // 2 + 1
-    won_rows = []
-    lost_rows = []
-    for ci in range(P):
-        active = req[ci] & (St[ci] == ROLE_CANDIDATE)  # survived wave 1
-        del_g = grants[ci] & Erev[ci]
-        del_r = (resps[ci] & ~grants[ci]) & Erev[ci]
-        agree_ci = st.agree[ci]
-        cnt_i = (active & st.voter_mask[ci]).astype(jnp.int32)  # self-vote
-        cnt_o = (active & st.outgoing_mask[ci]).astype(jnp.int32)
-        rec_i = cnt_i
-        rec_o = cnt_o
-        ff = jnp.zeros((G,), jnp.int32)
-        for v in range(P):
-            won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
-                (cnt_o >= q_o) | (n_o == 0)
-            )
-            lost_before = ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)) | (
-                (n_o > 0) & (cnt_o + (n_o - rec_o) < q_o)
-            )
-            snap = rej_snap[ci][v]
-            ok = (
-                del_r[v]
-                & ~won_before
-                & ~lost_before
-                & (snap <= agree_ci[v])
-            )
-            ff = jnp.where(ok, jnp.maximum(ff, snap), ff)
-            resp_v = del_g[v] | del_r[v]
-            rec_i = rec_i + (resp_v & st.voter_mask[v]).astype(jnp.int32)
-            rec_o = rec_o + (resp_v & st.outgoing_mask[v]).astype(jnp.int32)
-            cnt_i = cnt_i + (del_g[v] & st.voter_mask[v]).astype(jnp.int32)
-            cnt_o = cnt_o + (del_g[v] & st.outgoing_mask[v]).astype(
-                jnp.int32
-            )
+
+    def _wave2_inner(carry, xs):
+        cnt_i, cnt_o, rec_i, rec_o, ff = carry
+        dg_v, dr_v, snap_v, agree_v, vm_v, om_v = xs
+        won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
+            (cnt_o >= q_o) | (n_o == 0)
+        )
+        lost_before = ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)) | (
+            (n_o > 0) & (cnt_o + (n_o - rec_o) < q_o)
+        )
+        ok = dr_v & ~won_before & ~lost_before & (snap_v <= agree_v)
+        ff = jnp.where(ok, jnp.maximum(ff, snap_v), ff)
+        resp_v = dg_v | dr_v
+        rec_i = rec_i + (resp_v & vm_v).astype(jnp.int32)
+        rec_o = rec_o + (resp_v & om_v).astype(jnp.int32)
+        cnt_i = cnt_i + (dg_v & vm_v).astype(jnp.int32)
+        cnt_o = cnt_o + (dg_v & om_v).astype(jnp.int32)
+        return (cnt_i, cnt_o, rec_i, rec_o, ff), ()
+
+    def _wave2_body(C, xs):
+        (req_s, st_row, grants_s, resps_s, snap_s, erev_s, agree_s, vm_row,
+         om_row, sid) = xs
+        active = req_s & (st_row == ROLE_CANDIDATE)  # survived wave 1
+        del_g = grants_s & erev_s
+        del_r = (resps_s & ~grants_s) & erev_s
+        cnt_i = (active & vm_row).astype(jnp.int32)  # self-vote
+        cnt_o = (active & om_row).astype(jnp.int32)
+        (cnt_i, cnt_o, rec_i, rec_o, ff), _ = jax.lax.scan(
+            _wave2_inner,
+            (cnt_i, cnt_o, cnt_i, cnt_o, jnp.zeros((G,), jnp.int32)),
+            (
+                del_g, del_r, snap_s, agree_s, st.voter_mask,
+                st.outgoing_mask,
+            ),
+        )
         won_ci = (
             active
             & ((cnt_i >= q_i) | (n_i == 0))
@@ -979,11 +992,18 @@ def _linked_step(
                 | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
             )
         )
-        won_rows.append(won_ci)
-        lost_rows.append(lost_ci)
-        C = C.at[ci].set(jnp.maximum(C[ci], ff))
-    won = jnp.stack(won_rows)  # [P, G]
-    lost = jnp.stack(lost_rows)
+        row = jax.lax.dynamic_index_in_dim(C, sid, 0, keepdims=False)
+        C = jnp.where(p_idx == sid, jnp.maximum(row, ff)[None, :], C)
+        return C, (won_ci, lost_ci)
+
+    C, (won, lost) = jax.lax.scan(
+        _wave2_body,
+        C,
+        (
+            req, St, grants, resps, rej_snap, Erev, st.agree,
+            st.voter_mask, st.outgoing_mask, sender_ids,
+        ),
+    )
 
     # Winners become leaders and append their noop (reference:
     # raft.rs:1151-1202); a crashed/cut-off singleton campaigner wins here
@@ -1021,76 +1041,91 @@ def _linked_step(
     # before any wave-3 append can depose the processor).
     St2 = St
     C_send = C
-    acc1 = []
-    resumed = []  # heartbeat response arrived: pr.resume() at the leader
-    for s in range(P):
-        res = hb_accs[s] & Erev[s]
-        resumed.append(res)
+
+    def _pass1_body(carry, xs):
+        T, V, St, Ld, EE, RT, C, matched3, agree_run, LI, LT = carry
+        (e_s, erev_s, hbacc_s, m_row, li_row, li2_row, lt2_row, st2_row,
+         csend_row, won_s, t_row, sid) = xs
+        res = hbacc_s & erev_s  # pr.resume() at the leader
         cu = (
             res
-            & (st.matched[s] < st.last_index[s][None, :])
-            & (St2[s] == ROLE_LEADER)[None, :]
+            & (m_row < li_row[None, :])
+            & (st2_row == ROLE_LEADER)[None, :]
         )
-        dmask = E[s] & member & (won[s][None, :] | cu)
-        msg = dmask & (term[s][None, :] >= T)
+        dmask = e_s & member & (won_s[None, :] | cu)
+        msg = dmask & (t_row[None, :] >= T)
+        agree_s = jax.lax.dynamic_index_in_dim(
+            agree_run, sid, 0, keepdims=False
+        )
         # The winner's noop probe carries prev = its pre-noop cursor (the
         # fresh-reset Progress is unpaused, so it reaches everyone).
-        adopt = msg & (
-            cu
-            | (agree_run[s] >= st.last_index[s][None, :])
-            | Erev[s]
-        )
-        bump = msg & (term[s][None, :] > T)
-        T = jnp.where(msg, term[s][None, :], T)
+        adopt = msg & (cu | (agree_s >= li_row[None, :]) | erev_s)
+        bump = msg & (t_row[None, :] > T)
+        T = jnp.where(msg, t_row[None, :], T)
         V = jnp.where(bump, 0, V)
         St = jnp.where(msg, ROLE_FOLLOWER, St)
-        Ld = jnp.where(msg, s + 1, Ld)
+        Ld = jnp.where(msg, sid + 1, Ld)
         EE = jnp.where(msg, 0, EE)
         RT = jnp.where(bump, draw(T), RT)
-        C = jnp.where(adopt, jnp.maximum(C, C_send[s][None, :]), C)
-        ack = adopt & Erev[s]
-        matched3 = matched3.at[s].set(
-            jnp.where(
-                ack,
-                jnp.maximum(matched3[s], li2[s][None, :]),
-                matched3[s],
-            )
+        C = jnp.where(adopt, jnp.maximum(C, csend_row[None, :]), C)
+        ack = adopt & erev_s
+        m3_s = jax.lax.dynamic_index_in_dim(matched3, sid, 0, keepdims=False)
+        matched3 = jnp.where(
+            (jnp.arange(P, dtype=jnp.int32) == sid)[:, None, None],
+            jnp.where(ack, jnp.maximum(m3_s, li2_row[None, :]), m3_s)[
+                None, :, :
+            ],
+            matched3,
         )
         sent_any = jnp.any(adopt, axis=0)  # [G]
-        in_s = adopt | ((p_idx == s) & sent_any[None, :])
-        lead_row = agree_run[s]
+        in_s = adopt | ((p_idx == sid) & sent_any[None, :])
+        lead_row = agree_s
         agree_run = jnp.where(
             in_s[:, None, :] & in_s[None, :, :],
-            li2[s][None, None, :],
+            li2_row[None, None, :],
             jnp.where(
                 in_s[:, None, :],
                 lead_row[None, :, :],
                 jnp.where(in_s[None, :, :], lead_row[:, None, :], agree_run),
             ),
         )
-        acc1.append(adopt)
-    LI = li2
-    LT = lt2
-    for s in range(P):
-        LI = jnp.where(acc1[s], li2[s][None, :], LI)
-        LT = jnp.where(acc1[s], lt2[s][None, :], LT)
+        LI = jnp.where(adopt, li2_row[None, :], LI)
+        LT = jnp.where(adopt, lt2_row[None, :], LT)
+        return (T, V, St, Ld, EE, RT, C, matched3, agree_run, LI, LT), (res,)
+
+    (
+        (T, V, St, Ld, EE, RT, C, matched3, agree_run, LI, LT),
+        (resumed,),
+    ) = jax.lax.scan(
+        _pass1_body,
+        (T, V, St, Ld, EE, RT, C, matched3, agree_run, li2, lt2),
+        (
+            E, Erev, hb_accs, st.matched, st.last_index, li2, lt2, St2,
+            C_send, won, term, sender_ids,
+        ),
+    )
 
     # Stage-A quorum commit per leader off the freshly acked matched rows
     # (the term gate is raft_log.maybe_commit's own-term check).
-    adv = []
-    for s in range(P):
+    def _commit_a_body(C, xs):
+        m3_row, st_row, ts_row, sid = xs
         mci = jnp.minimum(
-            _quorum_index(matched3[s], st.voter_mask),
-            _quorum_index(matched3[s], st.outgoing_mask),
+            _quorum_index(m3_row, st.voter_mask),
+            _quorum_index(m3_row, st.outgoing_mask),
         )
+        c_s = jax.lax.dynamic_index_in_dim(C, sid, 0, keepdims=False)
         ok = (
-            (St[s] == ROLE_LEADER)
-            & (mci >= TS[s])
+            (st_row == ROLE_LEADER)
+            & (mci >= ts_row)
             & (mci < kernels.INF)
         )
-        c_new = jnp.where(ok, jnp.maximum(C[s], mci), C[s])
-        adv.append(c_new > C[s])
-        C = C.at[s].set(c_new)
+        c_new = jnp.where(ok, jnp.maximum(c_s, mci), c_s)
+        C = jnp.where(p_idx == sid, c_new[None, :], C)
+        return C, (c_new > c_s,)
+
+    C, (adv,) = jax.lax.scan(
+        _commit_a_body, C, (matched3, St, TS, sender_ids)
+    )
 
     # Pass 2: a commit advance re-broadcasts appends to every member whose
     # Progress can still send (bcast_append on maybe_commit; reference:
@@ -1100,57 +1135,68 @@ def _linked_step(
     # current last, so only in-sync members (or reverse-linked ones, via
     # the retry chain) accept it — a one-way member that missed a send
     # stays gapped until its reverse link heals.
-    for s in range(P):
-        dmask = (
-            E[s]
-            & member
-            & adv[s][None, :]
-            & ((matched3[s] > 0) | resumed[s])
+    def _pass2_body(carry, xs):
+        T, V, St, Ld, EE, RT, LI, LT, matched3, agree_run = carry
+        (e_s, erev_s, adv_s, res_s, li2_row, lt2_row, t_row, sid) = xs
+        m3_s = jax.lax.dynamic_index_in_dim(matched3, sid, 0, keepdims=False)
+        dmask = e_s & member & adv_s[None, :] & ((m3_s > 0) | res_s)
+        msg = dmask & (t_row[None, :] >= T)
+        agree_s = jax.lax.dynamic_index_in_dim(
+            agree_run, sid, 0, keepdims=False
         )
-        msg = dmask & (term[s][None, :] >= T)
-        adopt = msg & ((agree_run[s] >= li2[s][None, :]) | Erev[s])
-        bump = msg & (term[s][None, :] > T)
-        T = jnp.where(msg, term[s][None, :], T)
+        adopt = msg & ((agree_s >= li2_row[None, :]) | erev_s)
+        bump = msg & (t_row[None, :] > T)
+        T = jnp.where(msg, t_row[None, :], T)
         V = jnp.where(bump, 0, V)
         St = jnp.where(msg, ROLE_FOLLOWER, St)
-        Ld = jnp.where(msg, s + 1, Ld)
+        Ld = jnp.where(msg, sid + 1, Ld)
         EE = jnp.where(msg, 0, EE)
         RT = jnp.where(bump, draw(T), RT)
-        LI = jnp.where(adopt, li2[s][None, :], LI)
-        LT = jnp.where(adopt, lt2[s][None, :], LT)
-        a = adopt
-        ack = a & Erev[s]
-        matched3 = matched3.at[s].set(
-            jnp.where(
-                ack,
-                jnp.maximum(matched3[s], li2[s][None, :]),
-                matched3[s],
-            )
+        LI = jnp.where(adopt, li2_row[None, :], LI)
+        LT = jnp.where(adopt, lt2_row[None, :], LT)
+        ack = adopt & erev_s
+        matched3 = jnp.where(
+            (jnp.arange(P, dtype=jnp.int32) == sid)[:, None, None],
+            jnp.where(ack, jnp.maximum(m3_s, li2_row[None, :]), m3_s)[
+                None, :, :
+            ],
+            matched3,
         )
-        sent_any = jnp.any(a, axis=0)
-        in_s = a | ((p_idx == s) & sent_any[None, :])
-        lead_row = agree_run[s]
+        sent_any = jnp.any(adopt, axis=0)
+        in_s = adopt | ((p_idx == sid) & sent_any[None, :])
+        lead_row = agree_s
         agree_run = jnp.where(
             in_s[:, None, :] & in_s[None, :, :],
-            li2[s][None, None, :],
+            li2_row[None, None, :],
             jnp.where(
                 in_s[:, None, :],
                 lead_row[None, :, :],
                 jnp.where(in_s[None, :, :], lead_row[:, None, :], agree_run),
             ),
         )
-    for s in range(P):
+        return (T, V, St, Ld, EE, RT, LI, LT, matched3, agree_run), ()
+
+    (T, V, St, Ld, EE, RT, LI, LT, matched3, agree_run), _ = jax.lax.scan(
+        _pass2_body,
+        (T, V, St, Ld, EE, RT, LI, LT, matched3, agree_run),
+        (E, Erev, adv, resumed, li2, lt2, term, sender_ids),
+    )
+
+    def _commit_b_body(C, xs):
+        (m3_row, st_row, ts_row, e_s, erev_s, res_s, agree_s, li2_row,
+         csend_row, t_row, sid) = xs
         mci = jnp.minimum(
-            _quorum_index(matched3[s], st.voter_mask),
-            _quorum_index(matched3[s], st.outgoing_mask),
+            _quorum_index(m3_row, st.voter_mask),
+            _quorum_index(m3_row, st.outgoing_mask),
         )
+        c_s = jax.lax.dynamic_index_in_dim(C, sid, 0, keepdims=False)
         ok = (
-            (St[s] == ROLE_LEADER)
-            & (mci >= TS[s])
+            (st_row == ROLE_LEADER)
+            & (mci >= ts_row)
             & (mci < kernels.INF)
         )
-        c_new = jnp.where(ok, jnp.maximum(C[s], mci), C[s])
-        C = C.at[s].set(c_new)
+        c_new = jnp.where(ok, jnp.maximum(c_s, mci), c_s)
+        C = jnp.where(p_idx == sid, c_new[None, :], C)
         # Commit propagation: if LEADER s's commit advanced past what its
         # append sends carried, the post-advance broadcast delivers the
         # settled value — to sendable Progresses only (paused probes miss
@@ -1159,15 +1205,25 @@ def _linked_step(
         # The leadership gate matters: a stale ex-leader whose commit rose
         # this round as a RECEIVER broadcasts nothing.
         elig = (
-            E[s]
+            e_s
             & member
-            & (St[s] == ROLE_LEADER)[None, :]
-            & (term[s][None, :] >= T)
-            & ((matched3[s] > 0) | resumed[s])
-            & ((agree_run[s] >= li2[s][None, :]) | Erev[s])
-            & (c_new > C_send[s])[None, :]
+            & (st_row == ROLE_LEADER)[None, :]
+            & (t_row[None, :] >= T)
+            & ((m3_row > 0) | res_s)
+            & ((agree_s >= li2_row[None, :]) | erev_s)
+            & (c_new > csend_row)[None, :]
         )
         C = jnp.where(elig, jnp.maximum(C, c_new[None, :]), C)
+        return C, ()
+
+    C, _ = jax.lax.scan(
+        _commit_b_body,
+        C,
+        (
+            matched3, St, TS, E, Erev, resumed, agree_run, li2, C_send,
+            term, sender_ids,
+        ),
+    )
 
     # ---- the round's append workload at the acting leader (the scalar
     # round's propose-then-pump segment, evaluated after the tick pump
@@ -1192,7 +1248,7 @@ def _linked_step(
         matched3 * acting_f[:, None, :], axis=0, dtype=jnp.int32
     )
     resumed_act = jnp.any(
-        jnp.stack(resumed) & is_acting_leader[:, None, :], axis=0
+        resumed & is_acting_leader[:, None, :], axis=0
     )
     agree_act = jnp.sum(
         agree_run * acting_f[:, None, :], axis=0, dtype=jnp.int32
@@ -1391,6 +1447,9 @@ class ClusterSim:
         self._chaos = chaos
         self._chaos_compiled = None
         self._chaos_runner = None
+        # Compiled multi-round scan runners (run_compiled), cached per
+        # (rounds, link-threading) so repeated calls pay one compile.
+        self._scan_runners: dict = {}
         self._counters: Optional[jnp.ndarray] = None
         self._step_counted = None
         self._health: Optional[HealthState] = None
@@ -1534,6 +1593,109 @@ class ClusterSim:
     def run(self, rounds: int, crashed=None, append_n=None) -> SimState:
         for _ in range(rounds):
             self.run_round(crashed, append_n)
+        return self.state
+
+    def _compiled_runner(self, rounds: int, has_link: bool):
+        """Jitted `rounds`-round lax.scan with the WHOLE carry donated —
+        state (and counter/health extras) double-buffer in place instead of
+        paying a fresh allocation + host dispatch per round, the same shape
+        the chaos runner uses (chaos.make_runner).  Cached per (rounds,
+        link-threading)."""
+        key = (rounds, has_link)
+        runner = self._scan_runners.get(key)
+        if runner is not None:
+            return runner
+        cfg = self.cfg
+        cc = self._counters is not None
+        ch = self._health is not None
+        n_extra = (1 if cc else 0) + (1 if ch else 0)
+
+        def run(st, crashed, append_n, *extra):
+            link = extra[n_extra] if has_link else None
+
+            def body(carry, _):
+                s, *ex = carry
+                kw = {}
+                j = 0
+                if cc:
+                    kw["counters"] = ex[j]
+                    j += 1
+                if ch:
+                    kw["health"] = ex[j]
+                res = step(cfg, s, crashed, append_n, link=link, **kw)
+                # SimState is itself a tuple subtype: wrap by flag.
+                if not (cc or ch):
+                    res = (res,)
+                return tuple(res), ()
+
+            carry, _ = jax.lax.scan(
+                body, (st,) + tuple(extra[:n_extra]), None, length=rounds
+            )
+            return carry
+
+        runner = jax.jit(
+            run, donate_argnums=(0,) + tuple(range(3, 3 + n_extra))
+        )
+        self._scan_runners[key] = runner
+        return runner
+
+    def run_compiled(
+        self, rounds: int, crashed=None, append_n=None, link=None
+    ) -> SimState:
+        """Advance `rounds` lockstep rounds as donated jitted lax.scan(s):
+        zero per-round host dispatches and a double-buffered carry, for
+        constant crashed/append/link planes (the bench schedule).  With
+        counters enabled the scan is chunked to the GC008 drain cap (a
+        residual window carried in from prior run_round calls is drained
+        up front, so the undrained window provably never exceeds the cap)
+        and the host drain cadence runs between chunks; with a
+        HealthMonitor attached the scan is chunked to the drain cadence so
+        the monitor sees the same summary stream run_round would feed it.
+        Health-only with no monitor runs one scan — there is nothing to
+        drain to."""
+        G, P = self.cfg.n_groups, self.cfg.n_peers
+        if crashed is None:
+            crashed = jnp.zeros((P, G), bool)
+        if append_n is None:
+            append_n = jnp.zeros((G,), jnp.int32)
+        cc = self._counters is not None
+        ch = self._health is not None
+        if cc:
+            seg_max = self._drain_cap
+        elif ch and self.health_monitor is not None:
+            seg_max = self._drain_every
+        else:
+            seg_max = rounds
+        done = 0
+        while done < rounds:
+            seg = min(seg_max, rounds - done)
+            if cc and self._rounds_since_drain:
+                if self._rounds_since_drain + seg > self._drain_cap:
+                    # A residual run_round window plus this scan segment
+                    # would stretch past the GC008-proven cap: settle it
+                    # first (the drain zeroes the in-flight window).
+                    self._drain()
+            runner = self._compiled_runner(seg, link is not None)
+            args = [self.state, crashed, append_n]
+            if cc:
+                args.append(self._counters)
+            if ch:
+                args.append(self._health)
+            if link is not None:
+                args.append(link)
+            out = runner(*args)
+            self.state = out[0]
+            i = 1
+            if cc:
+                self._counters = out[i]
+                i += 1
+            if ch:
+                self._health = out[i]
+            done += seg
+            if cc or ch:
+                self._rounds_since_drain += seg
+                if self._rounds_since_drain >= self._drain_every:
+                    self._drain()
         return self.state
 
     # --- chaos engine (see raft_tpu/multiraft/chaos.py) ---

@@ -51,6 +51,35 @@ def force_virtual_cpu(n_devices: int) -> None:
         pass
 
 
+def enable_compile_cache() -> bool:
+    """Opt-in persistent XLA compilation cache (ROADMAP item 3c: compile
+    seconds are tier-1 budget).
+
+    When the env var RAFT_TPU_COMPILE_CACHE names a directory, point jax's
+    persistent compilation cache there so repeated test/bench processes
+    reuse compiled executables across runs (CI caches the directory
+    between jobs).  No-op (returns False) when the var is unset or the
+    running jax predates the cache options — the cache is an accelerator,
+    never a requirement."""
+    path = os.environ.get("RAFT_TPU_COMPILE_CACHE", "")
+    if not path:
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # The multi-second compiles worth caching here are the link-path /
+        # fused-kernel jits; sub-second ones would only bloat the cache.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except (AttributeError, RuntimeError):
+        return False
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except (AttributeError, RuntimeError):
+        pass  # older jax: size floor stays at its default
+    return True
+
+
 def require_virtual_cpu(n_devices: int) -> list:
     """Hard guarantee that the live backend is CPU with >= n_devices virtual
     devices; returns the device list.  Raises one actionable RuntimeError for

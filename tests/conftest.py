@@ -9,7 +9,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from raft_tpu.platform import force_virtual_cpu, require_virtual_cpu  # noqa: E402
+from raft_tpu.platform import (  # noqa: E402
+    enable_compile_cache,
+    force_virtual_cpu,
+    require_virtual_cpu,
+)
 
 force_virtual_cpu(8)
 require_virtual_cpu(8)
+# Persistent XLA compile cache (opt-in via RAFT_TPU_COMPILE_CACHE; CI caches
+# the directory between runs): compile seconds are tier-1 budget.
+enable_compile_cache()

@@ -1,0 +1,127 @@
+"""The bench.py perf-regression gate (--check): the comparison logic, the
+spread-flag validity downgrade, and the REQUIRED negative test — a
+synthetic regressed baseline must fail the gate with a non-zero exit.
+
+Pure host-side logic: no device work, no timed regions."""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+import jax  # noqa: E402
+
+
+def line(metric="raft_ticks_per_sec_100k_groups_5_peers", median=900e6,
+         groups=bench.G, flagged=False):
+    return {
+        "metric": metric,
+        "median": median,
+        "groups": groups,
+        "reps": 5,
+        "spread_pct": 5.0,
+        "spread_flagged": flagged,
+    }
+
+
+def key(metric="raft_ticks_per_sec_100k_groups_5_peers", groups=bench.G):
+    return f"{metric}@{jax.default_backend()}@g{groups}"
+
+
+def test_check_passes_within_threshold():
+    baseline = {key(): {"median": 1000e6, "threshold_pct": 15.0}}
+    ok, verdict = bench.check_against_baseline(line(median=900e6), baseline)
+    assert ok and verdict["status"] == "ok"
+
+
+def test_check_fails_on_regression():
+    """The acceptance-criterion negative test: a synthetic baseline far
+    above the measured median fails the gate."""
+    baseline = {key(): {"median": 1e15, "threshold_pct": 15.0}}
+    ok, verdict = bench.check_against_baseline(line(median=900e6), baseline)
+    assert not ok and verdict["status"] == "regressed"
+
+
+def test_check_spread_flag_is_the_validity_check():
+    """A >20% spread (PR 1's flag) downgrades the gate: a noisy run can
+    assert neither a regression nor a pass."""
+    baseline = {key(): {"median": 1e15, "threshold_pct": 15.0}}
+    ok, verdict = bench.check_against_baseline(
+        line(median=900e6, flagged=True), baseline
+    )
+    assert ok and verdict["status"] == "spread-flagged"
+
+
+def test_check_missing_baseline_passes():
+    ok, verdict = bench.check_against_baseline(line(), {})
+    assert ok and verdict["status"] == "no-baseline"
+
+
+def test_check_keys_distinguish_configurations():
+    """steady / health-on / chaos-on medians live under different keys —
+    an instrumented run can never gate against the uninstrumented series."""
+    ks = {
+        key("raft_ticks_per_sec_100k_groups_5_peers"),
+        key("raft_ticks_per_sec_100k_groups_5_peers_health"),
+        key("raft_ticks_per_sec_100k_groups_5_peers_chaos"),
+        key("raft_ticks_per_sec_100k_groups_5_peers", groups=256),
+    }
+    assert len(ks) == 4
+
+
+def test_run_check_cli_negative(tmp_path):
+    """End-to-end through run_check: write a synthetic regressed baseline,
+    assert SystemExit(1) and a verdict artifact."""
+    basefile = tmp_path / "base.json"
+    lf = line(median=900e6)
+    basefile.write_text(
+        json.dumps({key(): {"median": 1e15, "threshold_pct": 15.0}}),
+        encoding="utf-8",
+    )
+    out = tmp_path / "verdict.json"
+    args = argparse.Namespace(
+        check=str(basefile), check_out=str(out), check_threshold=None,
+        update_baseline=False,
+    )
+    with pytest.raises(SystemExit) as e:
+        bench.run_check(args, lf)
+    assert e.value.code == 1
+    verdict = json.loads(out.read_text(encoding="utf-8"))
+    assert verdict["status"] == "regressed"
+
+
+def test_run_check_update_baseline_refuses_flagged_run(tmp_path):
+    """The validity rule cuts both ways: a spread-flagged run cannot be
+    recorded as the committed floor."""
+    basefile = tmp_path / "base.json"
+    args = argparse.Namespace(
+        check=str(basefile), check_out="", check_threshold=None,
+        update_baseline=True,
+    )
+    with pytest.raises(SystemExit) as e:
+        bench.run_check(args, line(median=900e6, flagged=True))
+    assert e.value.code == 1
+    assert not basefile.exists()
+
+
+def test_run_check_update_baseline(tmp_path):
+    basefile = tmp_path / "base.json"
+    args = argparse.Namespace(
+        check=str(basefile), check_out="", check_threshold=30.0,
+        update_baseline=True,
+    )
+    bench.run_check(args, line(median=900e6))
+    saved = json.loads(basefile.read_text(encoding="utf-8"))
+    entry = saved[key()]
+    assert entry["median"] == 900e6 and entry["threshold_pct"] == 30.0
+    # and the freshly recorded baseline passes its own check
+    args2 = argparse.Namespace(
+        check=str(basefile), check_out="", check_threshold=None,
+        update_baseline=False,
+    )
+    bench.run_check(args2, line(median=900e6))

@@ -98,6 +98,53 @@ def test_chaos_off_graph_identical():
     )(st, crashed, app)
     assert str(base) == str(with_none)
 
+    # The donated multi-round runner (ClusterSim.run_compiled) scans the
+    # same step: with link/counters/health all None the per-round graph
+    # inside the scan is bit-identical to scanning the bare step — the
+    # packed/donated paths cannot leak into the chaos-off graph.
+    def scan_plain(s, c, a):
+        def body(x, _):
+            return sim_mod.step(cfg, x, c, a), ()
+
+        return jax.lax.scan(body, s, None, length=3)[0]
+
+    def scan_none(s, c, a):
+        def body(x, _):
+            return (
+                sim_mod.step(
+                    cfg, x, c, a, group_ids=None, counters=None,
+                    health=None, link=None,
+                ),
+                (),
+            )
+
+        return jax.lax.scan(body, s, None, length=3)[0]
+
+    assert str(jax.make_jaxpr(scan_plain)(st, crashed, app)) == str(
+        jax.make_jaxpr(scan_none)(st, crashed, app)
+    )
+
+
+def test_run_compiled_matches_stepping(shared_sim):
+    """ClusterSim.run_compiled (ONE donated lax.scan, double-buffered
+    carry) == the run_round python loop on the same constant masks —
+    state AND health planes, with a one-way link cut in the plane."""
+    sim = reset(shared_sim)
+    link_np = np.ones((P, P, G), bool)
+    link_np[0, 1, ::2] = False  # one-way cut on even groups
+    link = jnp.asarray(link_np)
+    app = jnp.ones((G,), jnp.int32)
+    for _ in range(12):
+        sim.run_round(append_n=app, link=link)
+    want = {f: np.asarray(getattr(sim.state, f)) for f in sim.state._fields}
+    want_planes = np.asarray(sim._health.planes)
+
+    sim = reset(shared_sim)
+    sim.run_compiled(12, append_n=app, link=link)
+    for f, w in want.items():
+        assert np.array_equal(np.asarray(getattr(sim.state, f)), w), f
+    assert np.array_equal(np.asarray(sim._health.planes), want_planes)
+
 
 # --- claim 4: the loss PRNG twin is bit-identical ---------------------------
 

@@ -107,3 +107,23 @@ def test_reset_counters():
     assert sim.counters()["campaigns"] > 0
     sim.reset_counters()
     assert all(v == 0 for v in sim.counters().values())
+
+
+def test_run_compiled_counter_totals_match_loop():
+    """run_compiled (donated scan, chunked to the GC008 drain cap) must
+    accumulate exactly the same counter totals as the run_round loop."""
+    cfg = SimConfig(n_groups=4, n_peers=3, collect_counters=True)
+    a, b = ClusterSim(cfg), ClusterSim(cfg)
+    app = jnp.ones((4,), jnp.int32)
+    rounds = 24
+    a.run(rounds, append_n=app)
+    # Chunking path: force a tiny drain cap so one run_compiled call spans
+    # several scan segments + host drains.
+    b._drain_cap = 16
+    b.run_compiled(rounds, append_n=app)
+    want, got = a.counters(), b.counters()
+    assert want == got, (want, got)
+    for f in a.state._fields:
+        assert np.array_equal(
+            np.asarray(getattr(a.state, f)), np.asarray(getattr(b.state, f))
+        ), f

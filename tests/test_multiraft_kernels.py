@@ -290,3 +290,67 @@ def test_device_plane_dtypes_stay_int32():
     pg = jnp.zeros((2, 8), jnp.int32)
     pp = jnp.zeros((2, 2, 8), jnp.int32)
     assert kernels.check_safety(pg, pg, pg, pg, pp, pg).dtype == jnp.int32
+
+    # Packed planes (GC008 PACKED_PLANES): words are uint32, unpacking
+    # restores the registered lane dtypes (bool / int32) exactly.
+    bools = jnp.zeros((5, 8), bool)
+    words = kernels.pack_bits(bools)
+    assert words.dtype == jnp.uint32
+    assert kernels.unpack_bits(words, 5).dtype == jnp.bool_
+    vals = jnp.zeros((5, 8), jnp.int32)
+    pw = kernels.pack_u16_pairs(vals)
+    assert pw.dtype == jnp.uint32
+    assert kernels.unpack_u16_pairs(pw, 5).dtype == jnp.int32
+
+    # The compiled chaos schedule stores ONLY packed words + int32 planes.
+    from raft_tpu.multiraft import chaos
+
+    plan = chaos.plan_from_dict(
+        {
+            "name": "t",
+            "peers": 3,
+            "phases": [
+                {"rounds": 2, "partition": [[1], [2, 3]], "crash": [2],
+                 "loss_all": 0.25, "append": 1},
+            ],
+        }
+    )
+    compiled = chaos.compile_plan(plan, 8)
+    assert compiled.phase_of_round.dtype == jnp.int32
+    assert compiled.link_packed.dtype == jnp.uint32
+    assert compiled.loss_packed.dtype == jnp.uint32
+    assert compiled.crashed_packed.dtype == jnp.uint32
+    assert compiled.append.dtype == jnp.int32
+
+
+def test_pack_bits_roundtrip_and_numpy_twin():
+    """pack_bits/unpack_bits: exact round-trip at widths spanning multiple
+    words, bit layout pinned against the obvious numpy twin."""
+    rng = np.random.RandomState(11)
+    for k in (1, 5, 25, 31, 32, 33, 64):
+        planes = rng.rand(k, 13) < 0.4
+        words = kernels.pack_bits(jnp.asarray(planes))
+        assert words.shape == ((k + 31) // 32, 13)
+        # numpy twin: word w bit j <- plane 32w + j
+        twin = np.zeros(((k + 31) // 32, 13), np.uint32)
+        for j in range(k):
+            twin[j // 32] |= planes[j].astype(np.uint32) << np.uint32(j % 32)
+        assert np.array_equal(np.asarray(words), twin)
+        back = kernels.unpack_bits(words, k)
+        assert np.array_equal(np.asarray(back), planes)
+
+
+def test_pack_u16_pairs_roundtrip_and_numpy_twin():
+    rng = np.random.RandomState(12)
+    for k in (1, 2, 5, 25):
+        vals = rng.randint(0, 1 << 16, size=(k, 9)).astype(np.int32)
+        words = kernels.pack_u16_pairs(jnp.asarray(vals))
+        assert words.shape == ((k + 1) // 2, 9)
+        twin = np.zeros(((k + 1) // 2, 9), np.uint32)
+        for j in range(k):
+            twin[j // 2] |= vals[j].astype(np.uint32) << np.uint32(
+                16 * (j % 2)
+            )
+        assert np.array_equal(np.asarray(words), twin)
+        back = kernels.unpack_u16_pairs(words, k)
+        assert np.array_equal(np.asarray(back), vals)

@@ -115,16 +115,21 @@ def test_multi_round_kernel_matches_k_steps():
 def test_fast_multi_round_full_schedule_parity():
     """fast_multi_round == k sequential sim.steps, including rounds where
     the predicate rejects (elections in progress)."""
+    import functools
+
     cfg = SimConfig(n_groups=8, n_peers=3)
     k = 4
-    fast = pallas_step.fast_multi_round(cfg, k=k)
+    # jitted drivers: eager per-op dispatch was the bulk of this test's
+    # wall time (tier-1 budget), and jit is how both sides run for real.
+    fast = jax.jit(pallas_step.fast_multi_round(cfg, k=k))
+    step = jax.jit(functools.partial(sim.step, cfg))
     a = sim.init_state(cfg)
     b = sim.init_state(cfg)
     crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
     for blk in range(8):  # 32 rounds: covers the initial election storm
         for _ in range(k):
-            a = sim.step(cfg, a, crashed, append)
+            a = step(a, crashed, append)
         b = fast(b, crashed, append)
         for f in a._fields:
             np.testing.assert_array_equal(
@@ -136,8 +141,11 @@ def test_fast_multi_round_full_schedule_parity():
 
 def test_fast_step_full_schedule_parity():
     """fast_step == sim.step across elections, crashes, recovery."""
+    import functools
+
     cfg = SimConfig(n_groups=8, n_peers=3)
-    fast = pallas_step.fast_step(cfg)
+    fast = jax.jit(pallas_step.fast_step(cfg))
+    step = jax.jit(functools.partial(sim.step, cfg))
     a = sim.init_state(cfg)
     b = sim.init_state(cfg)
     rng = np.random.RandomState(5)
@@ -147,7 +155,7 @@ def test_fast_step_full_schedule_parity():
             crashed[rng.randint(3), rng.randint(8)] ^= True
         c = jnp.asarray(crashed)
         append = jnp.asarray(rng.randint(0, 2, size=8).astype(np.int32))
-        a = sim.step(cfg, a, c, append)
+        a = step(a, c, append)
         b = fast(b, c, append)
         for f in a._fields:
             np.testing.assert_array_equal(
@@ -162,9 +170,12 @@ def test_hybrid_multi_round_localized_storm_parity():
     (leader crashes -> elections) while the rest stay steady: the storm
     groups must ride the gathered general-step sub-batch (with global
     timeout PRNG streams) and everyone else the fused kernel."""
+    import functools
+
     cfg = SimConfig(n_groups=16, n_peers=3)
     k = 4
-    hybrid = pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=4)
+    hybrid = jax.jit(pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=4))
+    step = jax.jit(functools.partial(sim.step, cfg))
     a = sim.init_state(cfg)
     b = sim.init_state(cfg)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
@@ -173,7 +184,7 @@ def test_hybrid_multi_round_localized_storm_parity():
     def run_block(a, b, crashed):
         c = jnp.asarray(crashed)
         for _ in range(k):
-            a = sim.step(cfg, a, c, append)
+            a = step(a, c, append)
         b = hybrid(b, c, append)
         for f in a._fields:
             np.testing.assert_array_equal(
@@ -200,16 +211,19 @@ def test_hybrid_multi_round_localized_storm_parity():
 
 def test_hybrid_storm_overflow_falls_back():
     """More storm groups than slots: exact whole-batch general fallback."""
+    import functools
+
     cfg = SimConfig(n_groups=8, n_peers=3)
     k = 3
-    hybrid = pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=1)
+    hybrid = jax.jit(pallas_step.hybrid_multi_round(cfg, k=k, storm_slots=1))
+    step = jax.jit(functools.partial(sim.step, cfg))
     a = sim.init_state(cfg)  # boot: all 8 groups non-steady
     b = sim.init_state(cfg)
     crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
     append = jnp.ones((cfg.n_groups,), jnp.int32)
     for blk in range(10):
         for _ in range(k):
-            a = sim.step(cfg, a, crashed, append)
+            a = step(a, crashed, append)
         b = hybrid(b, crashed, append)
         for f in a._fields:
             np.testing.assert_array_equal(
@@ -254,6 +268,296 @@ def test_steady_round_health_matches_general_steps():
         np.asarray(want_h.planes), np.asarray(got_h.planes)
     )
     assert int(want_h.window_pos) == int(got_h.window_pos)
+
+
+# --- chaos-on (link + loss) fused coverage ----------------------------------
+
+
+def _chaos_cfg(G=8, P=3, **kw):
+    # election_tick must clear the fused horizon: the chaos path uses the
+    # conservative free-running timer bound (loss can drop any heartbeat).
+    return SimConfig(n_groups=G, n_peers=P, election_tick=60, **kw)
+
+
+def _loss_plane(G, P, seed=0):
+    del seed  # layouts are fixed; the arg keeps call sites self-describing
+    loss = np.zeros((P, P, G), np.int32)
+    # heavy loss on a few directed links, zero elsewhere
+    loss[0, 1, :] = 3000
+    loss[1, 0, ::2] = 5000
+    loss[(P - 1) % P, P // 2, 1::3] = 7000
+    return jnp.asarray(loss)
+
+
+def _make_general_linked(cfg, crashed, append, has_c=False, has_h=False):
+    """Jitted one-round general stepper over link & ~loss_draw — the
+    contract the fused chaos kernel must match bit-for-bit.  Built ONCE
+    per test (one link-path compile) and driven per round."""
+    from raft_tpu.multiraft import kernels
+
+    @jax.jit
+    def stepper(st, link, loss, r, *extras):
+        kw = {}
+        i = 0
+        if has_c:
+            kw["counters"] = extras[i]
+            i += 1
+        if has_h:
+            kw["health"] = extras[i]
+        eff = link & ~kernels.link_loss_draw(r, loss)
+        res = sim.step(cfg, st, crashed, append, link=eff, **kw)
+        if not (has_c or has_h):
+            res = (res,)
+        return res
+
+    def run_k(st, link, loss, rb, k, counters=None, health=None):
+        for r in range(k):
+            extras = ()
+            if has_c:
+                extras = extras + (counters,)
+            if has_h:
+                extras = extras + (health,)
+            res = stepper(st, link, loss, jnp.int32(rb + r), *extras)
+            st = res[0]
+            i = 1
+            if has_c:
+                counters = res[i]
+                i += 1
+            if has_h:
+                health = res[i]
+        return st, counters, health
+
+    return run_k
+
+
+def test_steady_chaos_kernel_matches_linked_steps():
+    """The loss-gated fused kernel == k general sim.step(link=) rounds,
+    across consecutive blocks with the PRNG round_base advancing (lagging
+    followers heal through the catch-up wave mid-stream)."""
+    cfg = _chaos_cfg()
+    G, P = cfg.n_groups, cfg.n_peers
+    st = settle(cfg, rounds=150)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    link = jnp.ones((P, P, G), bool)
+    loss = _loss_plane(G, P)
+    k = 4
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed, k, link))
+
+    fused = jax.jit(pallas_step.steady_round(cfg, rounds=k, with_chaos=True))
+    general = _make_general_linked(cfg, crashed, append)
+    a = b = st
+    rb = 150
+    for blk in range(5):
+        a, _, _ = general(a, link, loss, rb, k)
+        b = fused(b, crashed, append, loss, jnp.int32(rb))
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)),
+                np.asarray(getattr(b, f)),
+                err_msg=f"block {blk} field {f}",
+            )
+        rb += k
+
+
+def test_steady_chaos_kernel_with_crashed_follower():
+    cfg = _chaos_cfg()
+    G, P = cfg.n_groups, cfg.n_peers
+    st = settle(cfg, rounds=150)
+    crashed = np.zeros((P, G), bool)
+    leaders = np.asarray(st.state).argmax(axis=0)
+    for g in range(G):
+        crashed[(leaders[g] + 1) % P, g] = True
+    crashed = jnp.asarray(crashed)
+    append = jnp.ones((G,), jnp.int32)
+    link = jnp.ones((P, P, G), bool)
+    loss = _loss_plane(G, P, seed=1)
+    k = 3
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed, k, link))
+    fused = jax.jit(pallas_step.steady_round(cfg, rounds=k, with_chaos=True))
+    general = _make_general_linked(cfg, crashed, append)
+    want, _, _ = general(st, link, loss, 40, k)
+    got = fused(st, crashed, append, loss, jnp.int32(40))
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want, f)), np.asarray(getattr(got, f)),
+            err_msg=f,
+        )
+
+
+def test_steady_counters_closed_form():
+    """with_counters: the closed-form CTR_* fold == threading the counter
+    plane through k general steps — plain AND chaos variants."""
+    from raft_tpu.multiraft import kernels
+
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    G, P = cfg.n_groups, cfg.n_peers
+    st = settle(cfg)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    k = 4
+    assert bool(pallas_step.steady_predicate(cfg, st, crashed, horizon=k))
+    fused = jax.jit(
+        pallas_step.steady_round(cfg, rounds=k, with_counters=True)
+    )
+    step_c = jax.jit(
+        lambda s, c: sim.step(cfg, s, crashed, append, counters=c)
+    )
+    want_st, want_c = st, kernels.zero_counters()
+    for _ in range(k):
+        want_st, want_c = step_c(want_st, want_c)
+    got_st, got_c = fused(st, crashed, append, kernels.zero_counters())
+    np.testing.assert_array_equal(np.asarray(want_c), np.asarray(got_c))
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want_st, f)), np.asarray(getattr(got_st, f)),
+            err_msg=f,
+        )
+
+    # chaos variant: counters + loss draws in one fused call
+    ccfg = _chaos_cfg()
+    st2 = settle(ccfg, rounds=150)
+    link = jnp.ones((P, P, G), bool)
+    loss = _loss_plane(G, P, seed=2)
+    fused_c = jax.jit(
+        pallas_step.steady_round(
+            ccfg, rounds=k, with_chaos=True, with_counters=True
+        )
+    )
+    general = _make_general_linked(ccfg, crashed, append, has_c=True)
+    want_st, want_c, _ = general(
+        st2, link, loss, 200, k, counters=kernels.zero_counters()
+    )
+    got_st, got_c = fused_c(
+        st2, crashed, append, loss, jnp.int32(200), kernels.zero_counters()
+    )
+    np.testing.assert_array_equal(np.asarray(want_c), np.asarray(got_c))
+    for f in st2._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(want_st, f)), np.asarray(getattr(got_st, f)),
+            err_msg=f,
+        )
+
+
+@pytest.mark.slow  # eager link-path rounds at P=5 + the health variant
+def test_fast_multi_round_chaos_both_branches():
+    """fast_multi_round(with_chaos, with_health): the fused branch engages
+    on a healed link plane (loss folded in-kernel) and the general branch
+    on a broken one — per-round health parity and bit-identical state
+    either way, at P=5 with joint-free masks."""
+    cfg = _chaos_cfg(G=6, P=5, collect_health=True, health_window=8)
+    G, P = cfg.n_groups, cfg.n_peers
+    st = settle(cfg, rounds=150)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    link = jnp.ones((P, P, G), bool)
+    loss = _loss_plane(G, P, seed=3)
+    k = 4
+    fast = jax.jit(
+        pallas_step.fast_multi_round(cfg, k=k, with_chaos=True,
+                                     with_health=True)
+    )
+    general = _make_general_linked(cfg, crashed, append, has_h=True)
+    h = sim.init_health(cfg)
+    h = h._replace(
+        planes=h.planes.at[2].set(2).at[3].set(1), window_pos=jnp.int32(7)
+    )
+    a, b, ha, hb = st, st, h, h
+    rb = 150
+    # healed plane -> fused branch
+    assert bool(pallas_step.steady_predicate(cfg, a, crashed, k, link))
+    for blk in range(3):
+        a, _, ha = general(a, link, loss, rb, k, health=ha)
+        b, hb = fast(b, crashed, append, link, loss, jnp.int32(rb), hb)
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f"healed block {blk} field {f}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ha.planes), np.asarray(hb.planes)
+        )
+        assert int(ha.window_pos) == int(hb.window_pos)
+        rb += k
+    # a single down link -> predicate rejects -> general branch, still exact
+    link_bad = link.at[0, 1, 0].set(False)
+    assert not bool(
+        pallas_step.steady_predicate(cfg, a, crashed, k, link_bad)
+    )
+    a, _, ha = general(a, link_bad, loss, rb, k, health=ha)
+    b, hb = fast(b, crashed, append, link_bad, loss, jnp.int32(rb), hb)
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"general branch field {f}",
+        )
+    np.testing.assert_array_equal(
+        np.asarray(ha.planes), np.asarray(hb.planes)
+    )
+
+
+def test_fast_multi_round_counters_both_branches():
+    """The with_counters dispatcher: the closed-form fused fold (steady
+    start) and the scan-of-general branch (boot storm) both thread the
+    CTR_* plane exactly."""
+    from raft_tpu.multiraft import kernels
+
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    k = 4
+    fast = jax.jit(
+        pallas_step.fast_multi_round(cfg, k=k, with_counters=True)
+    )
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    step_c = jax.jit(
+        lambda s, c: sim.step(cfg, s, crashed, append, counters=c)
+    )
+    for start in ("steady", "boot"):
+        st = settle(cfg) if start == "steady" else sim.init_state(cfg)
+        want_st, want_c = st, kernels.zero_counters()
+        for _ in range(k):
+            want_st, want_c = step_c(want_st, want_c)
+        got_st, got_c = fast(st, crashed, append, kernels.zero_counters())
+        np.testing.assert_array_equal(
+            np.asarray(want_c), np.asarray(got_c), err_msg=start
+        )
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want_st, f)),
+                np.asarray(getattr(got_st, f)),
+                err_msg=f"{start} field {f}",
+            )
+
+
+def test_plain_jaxpr_unchanged_by_new_flags():
+    """The chaos/counters machinery must not perturb the flag-off graphs:
+    steady_round and fast_multi_round trace identically with the new flags
+    defaulted and explicitly off (the packed/donated-path extension of the
+    PR 5 chaos-off jaxpr pin)."""
+    cfg = SimConfig(n_groups=4, n_peers=3)
+    st = sim.init_state(cfg)
+    crashed = jnp.zeros((3, 4), bool)
+    append = jnp.zeros((4,), jnp.int32)
+
+    base = jax.make_jaxpr(pallas_step.steady_round(cfg, rounds=2))(
+        st, crashed, append
+    )
+    flagged = jax.make_jaxpr(
+        pallas_step.steady_round(
+            cfg, rounds=2, with_chaos=False, with_counters=False
+        )
+    )(st, crashed, append)
+    assert str(base) == str(flagged)
+
+    base = jax.make_jaxpr(pallas_step.fast_multi_round(cfg, k=2))(
+        st, crashed, append
+    )
+    flagged = jax.make_jaxpr(
+        pallas_step.fast_multi_round(
+            cfg, k=2, with_chaos=False, with_counters=False
+        )
+    )(st, crashed, append)
+    assert str(base) == str(flagged)
 
 
 @pytest.mark.slow  # compiles the full cond(fused, scan-of-general) graph

@@ -67,6 +67,31 @@ HEALTH_PLANES: Dict[str, int] = {
 # target adopts an existing campaigner's term).
 DECLARED_BOUNDED: Dict[str, int] = {"term_bump": 1}
 
+# Registered packed-plane encodings: every sub-int32 value that rides in a
+# shared word must appear here with its bit budget and the derivation of
+# the bound (docs/STATIC_ANALYSIS.md "Packed planes").  A NEW pack_*/
+# unpack_* kernel pair in kernels.py whose base name is not registered
+# fails the build — packing an unbounded value silently truncates it.
+#   name -> (bits per lane, bound derivation summary)
+PACKED_PLANES: Dict[str, tuple] = {
+    # kernels.pack_bits/unpack_bits lanes: bools, 1 bit by construction.
+    "bits": (1, "bool planes; lossless by construction"),
+    # kernels.pack_u16_pairs/unpack_u16_pairs lanes: loss rates, which
+    # chaos._rate_to_fp validates into [0, LOSS_SCALE] with
+    # LOSS_SCALE == 10_000 < 2**16.
+    "u16_pairs": (16, "loss rates <= LOSS_SCALE (chaos._rate_to_fp)"),
+    # pallas_step's packed chaos-kernel operands (not kernels.py fns; the
+    # builders assert the bounds at construction time):
+    #   roles word = state | leader_id << 2 | heartbeat_elapsed << 6
+    #     state < 4 (the ROLE_* code set), leader_id <= n_peers (asserted
+    #     <= 15 in _build_chaos_round), heartbeat_elapsed <=
+    #     heartbeat_tick (tick_kernel resets at the tick; asserted
+    #     < 2**24 in _build_chaos_round).
+    "roles": (30, "state<4, leader_id<16, hb<=heartbeat_tick<2**24"),
+    #   masks word = voter | member << 1 | crashed << 2 (three bools).
+    "masks": (3, "three bool planes"),
+}
+
 
 def _v(sf: SourceFile, lineno: int, message: str) -> Violation:
     return Violation(sf.display_path, lineno, GC008, GC008_SLUG, message)
@@ -82,6 +107,7 @@ def check_kernels(sf: SourceFile) -> Iterator[Violation]:
     n_counters: Optional[int] = None
     n_health: Optional[int] = None
     update_health: Optional[ast.FunctionDef] = None
+    pack_fns: Dict[str, int] = {}
     for node in ast.iter_child_nodes(tree):
         if isinstance(node, ast.Assign) and isinstance(
             node.value, ast.Constant
@@ -103,6 +129,24 @@ def check_kernels(sf: SourceFile) -> Iterator[Violation]:
                     n_health = node.value.value
         elif isinstance(node, ast.FunctionDef) and node.name == "update_health":
             update_health = node
+        elif isinstance(node, ast.FunctionDef) and node.name.startswith(
+            ("pack_", "unpack_")
+        ):
+            # "pack_bits" and "unpack_bits" share the family name "bits".
+            base = node.name.split("_", 1)[1]
+            pack_fns[base] = node.lineno
+
+    for base, lineno in sorted(pack_fns.items()):
+        if base not in PACKED_PLANES:
+            yield _v(
+                sf,
+                lineno,
+                f"packed-plane kernel family `{base}` is not in the GC008 "
+                "PACKED_PLANES registry "
+                "(tools/graftcheck/engine/overflow.py); derive the per-lane "
+                "bit bound and register it (docs/STATIC_ANALYSIS.md) — "
+                "packing an unbounded value silently truncates it",
+            )
 
     for name, lineno in seen_ctr.items():
         if name not in COUNTER_PLANES:
