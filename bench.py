@@ -45,7 +45,9 @@ Flags (all optional; defaults reproduce the BENCH_r0x methodology):
 Each configuration gets its own metric key so BENCH_r* files distinguish
 which path was measured: the steady path keeps the historical
 `raft_ticks_per_sec_100k_groups_5_peers`, --health appends `_health`,
---lossy appends `_chaos` (both when combined: `_health_chaos`).
+--lossy appends `_chaos` (both when combined: `_health_chaos`), and
+--check-quorum appends `_cq` (the election-damping configuration —
+always the general damped wave path; steady_mask rejects damping-on).
 
 Perf-regression gate (docs/PERF.md):
 
@@ -118,6 +120,7 @@ def bench_device(
     profile_dir: str = "",
     health_out: str = "",
     lossy: float = -1.0,
+    check_quorum: bool = False,
 ) -> dict:
     from raft_tpu.multiraft import kernels, pallas_step, sim
     from raft_tpu.multiraft.sim import SimConfig
@@ -132,8 +135,13 @@ def bench_device(
     # lossy link can drop any heartbeat, so timers are assumed
     # free-running): the election timeout must clear the fused horizon or
     # the fused branch would never engage — election_tick=64 > K=32.
+    # --check-quorum benches the DAMPED configuration: steady_mask
+    # rejects damping-on wholesale, so every round runs the general
+    # damped wave path (sim._damped_linked_step) — the honest number for
+    # a fleet running the disruption-damping protocols.
     cfg = SimConfig(
-        n_groups=groups, n_peers=P, election_tick=64 if chaos else 10
+        n_groups=groups, n_peers=P, election_tick=64 if chaos else 10,
+        check_quorum=check_quorum,
     )
     state = sim.init_state(cfg)
     crashed = jnp.zeros((P, groups), bool)
@@ -270,7 +278,8 @@ def bench_device(
 
 
 def bench_chaos(
-    plan_path: str, groups: int, reps: int, chaos_out: str = ""
+    plan_path: str, groups: int, reps: int, chaos_out: str = "",
+    check_quorum: bool = False,
 ) -> dict:
     """Run a chaos plan as one compiled scan per rep and report both the
     scenario summary and the chaos-path throughput."""
@@ -280,7 +289,8 @@ def bench_chaos(
 
     plan = chaos.load_plan(plan_path)
     cfg = SimConfig(
-        n_groups=groups, n_peers=plan.n_peers, collect_health=True
+        n_groups=groups, n_peers=plan.n_peers, collect_health=True,
+        check_quorum=check_quorum,
     )
     compiled = chaos.compile_plan(plan, groups)
     runner = chaos.make_runner(cfg, compiled)
@@ -451,6 +461,7 @@ def main() -> None:
     ap.add_argument("--health", action="store_true")
     ap.add_argument("--health-out", default="", metavar="FILE")
     ap.add_argument("--lossy", type=float, default=-1.0, metavar="RATE")
+    ap.add_argument("--check-quorum", action="store_true")
     ap.add_argument("--groups", type=int, default=G)
     ap.add_argument("--reps", type=int, default=REPS)
     ap.add_argument("--skip-anchor", action="store_true")
@@ -474,16 +485,20 @@ def main() -> None:
 
     if args.chaos:
         chaos_stats = bench_chaos(
-            args.chaos, args.groups, args.reps, args.chaos_out
+            args.chaos, args.groups, args.reps, args.chaos_out,
+            check_quorum=args.check_quorum,
         )
         warn_spread("chaos device", chaos_stats)
         line = {
-            "metric": "raft_chaos_ticks_per_sec",
+            "metric": "raft_chaos_ticks_per_sec"
+            + ("_cq" if args.check_quorum else ""),
             "value": chaos_stats["median"],
             "unit": "ticks/sec",
             "groups": args.groups,
             **chaos_stats,
         }
+        if args.check_quorum:
+            line["check_quorum"] = True
         print(json.dumps(line))
         if args.check:
             run_check(args, line)
@@ -496,6 +511,7 @@ def main() -> None:
         profile_dir=args.profile,
         health_out=args.health_out,
         lossy=args.lossy,
+        check_quorum=args.check_quorum,
     )
     anchor = None if args.skip_anchor else bench_scalar_anchor(args.reps)
     # A flagged spread on EITHER side poisons vs_baseline (it is a ratio of
@@ -510,6 +526,8 @@ def main() -> None:
         metric += "_health"
     if args.lossy >= 0.0:
         metric += "_chaos"
+    if args.check_quorum:
+        metric += "_cq"
     line = {
         "metric": metric,
         "value": device["median"],
@@ -532,6 +550,8 @@ def main() -> None:
         line["health"] = True
     if args.lossy >= 0.0:
         line["lossy"] = args.lossy
+    if args.check_quorum:
+        line["check_quorum"] = True
     print(json.dumps(line))
     if args.check:
         run_check(args, line)

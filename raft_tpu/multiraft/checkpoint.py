@@ -27,8 +27,14 @@ _FORMAT_VERSION = 1
 
 
 def save_state(state: SimState, path: str) -> None:
-    """Atomically write the full device state to `path` (.npz)."""
-    arrays = {name: np.asarray(getattr(state, name)) for name in SimState._fields}
+    """Atomically write the full device state to `path` (.npz).  Optional
+    planes that are absent (recent_active on an undamped sim is None) are
+    skipped; load_state restores them as None."""
+    arrays = {
+        name: np.asarray(value)
+        for name in SimState._fields
+        if (value := getattr(state, name)) is not None
+    }
     arrays["__version__"] = np.asarray(_FORMAT_VERSION)
     dir_ = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
@@ -52,7 +58,19 @@ def load_state(path: str) -> SimState:
         if version != _FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {version}")
         fields = {}
+        # Only None-default fields are optional planes; a future field
+        # with a real default must still be present in every checkpoint.
+        optional = {
+            k for k, v in SimState._field_defaults.items() if v is None
+        }
         for name in SimState._fields:
+            if name not in data:
+                if name in optional:
+                    continue  # optional plane absent (undamped checkpoint)
+                raise ValueError(
+                    f"checkpoint {path!r} is missing required plane "
+                    f"{name!r} (corrupt or truncated file)"
+                )
             arr = data[name]
             # np.load arrays are strongly typed, so this dtype is the
             # checkpointed one verbatim — passed explicitly per the GC001

@@ -54,6 +54,12 @@ here is missing from it or untested under tests/.
                                (tests/test_chaos_parity.py drives it every
                                fuzz round; ChaosOracle holds the scalar
                                state it must never flag)
+  check_quorum_active      <-> tracker.ProgressTracker.quorum_recently_active
+                               (reference: tracker.rs:346-372); the damped
+                               round reads it at each leader's
+                               election-timeout boundary — per-round parity
+                               vs real check-quorum Rafts in
+                               tests/test_damping_parity.py
 
 TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
 committed_index is a fixed-width masked sort along the last axis that XLA
@@ -398,6 +404,37 @@ def check_safety(
             jnp.sum(jnp.any(invalid, axis=(0, 1)), dtype=jnp.int32),
         ]
     )
+
+
+def check_quorum_active(
+    recent_active: jnp.ndarray,  # gc: bool[P, P, G]
+    voter_mask: jnp.ndarray,  # gc: bool[P, G]
+    outgoing_mask: jnp.ndarray,  # gc: bool[P, G]
+) -> jnp.ndarray:
+    """Per-owner check-quorum liveness over the recent_active rows
+    (reference: tracker.rs:346-372, quorum_recently_active).
+
+    recent_active[owner, target, g] is the owner's Progress.recent_active
+    flag for `target` (set by sync-acks, read-and-cleared at the owner's
+    election-timeout boundary — the caller does the clearing).  The owner
+    itself always counts as active; a joint config needs BOTH majorities
+    active (has_quorum over conf.voters, i.e. joint vote_result semantics).
+
+    Returns bool[P, G]: whether owner p's view holds an active quorum.
+    """
+    P = recent_active.shape[0]
+    active = recent_active | jnp.eye(P, dtype=bool)[:, :, None]
+
+    def half(mask):
+        # dtype= on the masked counts: a bare bool sum widens to int64
+        # under x64 (GC007).
+        cnt = jnp.sum(
+            active & mask[None, :, :], axis=1, dtype=jnp.int32
+        )  # [P_owner, G]
+        n = jnp.sum(mask, axis=0, dtype=jnp.int32)[None, :]
+        return (cnt >= majority_of(n)) | (n == 0)
+
+    return half(voter_mask) & half(outgoing_mask)
 
 
 def timeout_draw(

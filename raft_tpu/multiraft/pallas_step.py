@@ -859,7 +859,17 @@ def steady_mask(
     election-timer bound falls back to the fully conservative free-running
     form: per-link LOSS may drop any heartbeat, so the per-round re-sync
     that lets the heartbeat_tick == 1 fast bound assume ee -> 0 cannot be
-    relied on."""
+    relied on.
+
+    Election damping (SimConfig.check_quorum / pre_vote) is NOT modeled
+    by the fused kernels: a steady round under damping also advances the
+    leader's recent_active row and its boundary read-and-clear, which the
+    kernels do not carry.  Damping-on configs are therefore rejected
+    wholesale (all-False mask), so the fused path can never silently
+    diverge — the dispatchers then always take sim.step's damped wave
+    path."""
+    if cfg.check_quorum or cfg.pre_vote:
+        return jnp.zeros((cfg.n_groups,), bool)
     alive = ~crashed
     # 1. nobody can campaign within the horizon.  With heartbeat_tick == 1
     # an alive follower under a live leader is re-synced (ee -> 0) every

@@ -39,10 +39,14 @@ def make_mesh(
     return jax.make_mesh((len(devices),), (axis,), devices=list(devices))
 
 
-def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
+def state_sharding(
+    mesh: Mesh, axis: str = "groups", damped: bool = False
+) -> SimState:
     """PartitionSpecs for every SimState field: the group axis (minor, the
     vector-lane axis of the peer-major [P, G] layout) is sharded; the peer
-    axis stays local to the chip."""
+    axis stays local to the chip.  `damped` adds the spec for the
+    recent_active [P, P, G] plane (present only when SimConfig damping is
+    on — it shards on G like the other pairwise planes)."""
     pg = NamedSharding(mesh, P(None, axis))
     ppg = NamedSharding(mesh, P(None, None, axis))
     return SimState(
@@ -51,11 +55,14 @@ def state_sharding(mesh: Mesh, axis: str = "groups") -> SimState:
         last_index=pg, last_term=pg, commit=pg,
         matched=ppg, term_start_index=pg, agree=ppg, voter_mask=pg,
         outgoing_mask=pg, learner_mask=pg,
+        recent_active=ppg if damped else None,
     )
 
 
 def shard_state(state: SimState, mesh: Mesh, axis: str = "groups") -> SimState:
-    shardings = state_sharding(mesh, axis)
+    shardings = state_sharding(
+        mesh, axis, damped=state.recent_active is not None
+    )
     return jax.tree.map(jax.device_put, state, shardings)
 
 
@@ -69,7 +76,9 @@ def sharded_step(
     the global shapes, the iota node keys stay global, and every op
     partitions trivially along G.
     """
-    shardings = state_sharding(mesh, axis)
+    shardings = state_sharding(
+        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+    )
     crashed_sh = NamedSharding(mesh, P(None, axis))
     append_sh = NamedSharding(mesh, P(axis))
     return jax.jit(
@@ -96,7 +105,10 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
         from jax.experimental.shard_map import shard_map
 
     state_specs = jax.tree.map(
-        lambda s: s.spec, state_sharding(mesh, axis)
+        lambda s: s.spec,
+        state_sharding(
+            mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+        ),
     )
 
     def local(st: SimState):
@@ -139,7 +151,9 @@ def sharded_read_index(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     sharding: each chip answers reads for its own group shard with zero
     cross-chip traffic — the consensus analog of a data-parallel inference
     step.  Returns a jitted fn (SimState, crashed[P, G]) -> int32[G]."""
-    shardings = state_sharding(mesh, axis)
+    shardings = state_sharding(
+        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+    )
     crashed_sh = NamedSharding(mesh, P(None, axis))
     return jax.jit(
         functools.partial(sim.read_index, cfg),

@@ -37,10 +37,21 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     first-class fast-path input: crashed peers keep ticking and
     campaigning but exchange no messages, and with `link=None` the
     traced graph is bit-identical to the pre-chaos build.
-  Not modeled on device (host path handles them): pre-vote, check-quorum
-  (incl. leases) — so one-way partitions inflate terms unboundedly, a
-  pinned behavior (tests/test_chaos_parity.py) — and snapshots; the
-  ReadIndex barrier stays crash-mask-only (not link-aware).
+  * election damping (ISSUE 7): SimConfig(check_quorum=True) runs the
+    reference check-quorum machinery on device — per-owner recent_active
+    rows read-and-cleared at the leader's election-timeout boundary, the
+    low-term nudge deposing stale leaders, and leader leases ignoring
+    disruptive vote requests at receipt time; pre_vote=True adds the
+    two-phase pre-election.  Both flags are trace-time static: flags-off
+    traces (and the flags-off SimState pytree) are bit-identical to the
+    undamped build, which keeps the one-way-partition term-inflation
+    pathology pinned (tests/test_chaos_parity.py) next to its damped
+    collapse (tests/test_damping_parity.py).  The ReadIndex barrier is
+    link-aware via read_index(link=).
+  Not modeled on device (host path handles them): snapshots, conf-change
+  application (host-side mask-swap barriers; a swap under check_quorum
+  does not carry the scalar side's added-node recent_active=True grace,
+  so pair swaps with a fresh election or accept one early boundary).
 
 Log model: each peer's log is summarized by (last_index, last_term) plus
 the pairwise agreement plane `agree[a, b]` (common-prefix length).  Logs DO
@@ -96,6 +107,21 @@ class SimConfig(NamedTuple):
     churn_bumps: int = 4
     # Worst-offender extraction width (jax.lax.top_k k).
     health_topk: int = 8
+    # Election damping (DESIGN.md §8, landed on device by ISSUE 7).
+    # check_quorum enables all three reference mechanisms: per-owner
+    # recent_active rows read-and-cleared at the leader's election-timeout
+    # boundary (step down without an active quorum, suppressing that
+    # round's heartbeat), the low-term nudge (receivers of lower-term
+    # append/heartbeat traffic respond at their own term, deposing stale
+    # leaders), and leader leases (a voter ignores higher-term vote
+    # requests while it heard from a live leader within election_tick
+    # ticks of receipt).  pre_vote enables the two-phase pre-election
+    # (candidates probe at term+1 without bumping anything) and, like the
+    # reference, also turns on the low-term nudge.  Both are trace-time
+    # static: the flags-off graph is bit-identical to the undamped build
+    # (damping-on rounds run the pairwise wave path, _damped_linked_step).
+    check_quorum: bool = False
+    pre_vote: bool = False
 
     @property
     def min_timeout(self) -> int:
@@ -146,6 +172,16 @@ class SimState(NamedTuple):
     # Learners (reference: tracker.rs:40-49): replicated to, never voting,
     # never campaigning, never counted in quorums.
     learner_mask: jnp.ndarray  # gc: bool[P, G]
+    # Per-OWNER check-quorum activity rows (reference: progress.rs
+    # recent_active), present ONLY when SimConfig damping is on — None
+    # otherwise, so the undamped pytree (and its traced graph) is
+    # bit-identical to the pre-damping build.  recent_active[owner,
+    # target, g] is set by sync-acks reaching `owner` while it leads and
+    # read-and-cleared (to the self-only row) at the owner's
+    # election-timeout boundary; cleared wholesale when `owner` wins an
+    # election (become_leader's tracker reset).  bool[P, P, G] when
+    # present.
+    recent_active: Optional[jnp.ndarray] = None  # gc: bool[P, P, G]
 
 
 class HealthState(NamedTuple):
@@ -213,7 +249,13 @@ def init_state(
     lo = jnp.full(shape, cfg.min_timeout, jnp.int32)
     hi = jnp.full(shape, cfg.max_timeout, jnp.int32)
     rt = kernels.timeout_draw(_node_key(cfg), jnp.zeros(shape, jnp.uint32), lo, hi)
+    recent_active = (
+        jnp.zeros((P, P, G), bool)
+        if (cfg.check_quorum or cfg.pre_vote)
+        else None
+    )
     return SimState(
+        recent_active=recent_active,
         term=zeros(),
         state=zeros(),
         vote=zeros(),
@@ -304,7 +346,21 @@ def step(
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
     phase is skipped wholesale when no peer campaigned this round.
+
+    Election damping (SimConfig.check_quorum / pre_vote) always runs the
+    pairwise wave path (_damped_linked_step) — lease decisions are
+    receipt-order-dependent, which only the per-receiver sender-ordered
+    replay expresses; with both flags False this dispatch (and the traced
+    graph) is unchanged.
     """
+    if cfg.check_quorum or cfg.pre_vote:
+        if link is None:
+            link = jnp.ones(
+                (cfg.n_peers, cfg.n_peers, cfg.n_groups), bool
+            )
+        return _damped_linked_step(
+            cfg, st, crashed, append_n, link, group_ids, counters, health
+        )
     if link is not None:
         return _linked_step(
             cfg, st, crashed, append_n, link, group_ids, counters, health
@@ -1358,10 +1414,1047 @@ def _linked_step(
     return (out,) + extras
 
 
+def _damped_linked_step(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    append_n: jnp.ndarray,  # gc: int32[G]
+    link: jnp.ndarray,  # gc: bool[P, P, G]
+    group_ids: Optional[jnp.ndarray] = None,
+    counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
+    health: Optional[HealthState] = None,  # gc: HealthState
+) -> Union[SimState, Tuple]:
+    """The damped (check-quorum / pre-vote / lease) pairwise round.
+
+    Extends _linked_step's wave replay with the three DESIGN.md §8
+    mechanisms, all receipt-order exact:
+
+      tick     each leader's election-timeout boundary reads-and-clears
+               its recent_active row; without an active quorum it steps
+               down AND suppresses that round's heartbeat
+               (tick_heartbeat returns before MsgBeat);
+      lease    a voter ignores a higher-term (pre-)vote request entirely
+               while leader_id != 0 and election_elapsed < election_tick
+               AT RECEIPT — the running (Ld, EE) planes of the
+               per-receiver sender-ordered scan ARE receipt time, so the
+               pump-position dependence (leader heartbeat before or after
+               the candidate's request) falls out of the replay order;
+      nudge    lower-term append/heartbeat traffic draws an empty
+               MsgAppendResponse at the receiver's term; the stale leader
+               processes it in response order, deposing it mid-stream —
+               acks after the first deposing nudge are dropped exactly
+               like the scalar step ignores them;
+      pre-vote campaigners probe at term+1 without bumping anything;
+               pre-winners run the REAL election two waves later, which
+               is where the scalar pump puts it — real vote requests
+               interleave with catch-up appends per receiver in sender
+               order, so the whole election block shifts into the append
+               waves when cfg.pre_vote is set.
+
+    Both flags are trace-time static; this function is only reached when
+    at least one is on, so the undamped graphs are untouched.  Parity:
+    per-round state AND health planes vs ScalarCluster(check_quorum=...,
+    pre_vote=...) in tests/test_damping_parity.py.
+    """
+    if st.recent_active is None:
+        raise ValueError(
+            "damped step (SimConfig.check_quorum/pre_vote) needs the "
+            "recent_active plane but the state has None — this state was "
+            "built for an undamped config (e.g. an undamped checkpoint "
+            "loaded into a damped sim); rebuild it with init_state(cfg) "
+            "or carry the plane over explicitly"
+        )
+    G, P = cfg.n_groups, cfg.n_peers
+    cq = cfg.check_quorum
+    pv = cfg.pre_vote
+    et = cfg.election_tick
+    self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
+    p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
+    alive = ~crashed
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    eye_pp = jnp.eye(P, dtype=bool)[:, :, None]
+    E = link & alive[:, None, :] & alive[None, :, :] & off_diag
+    Erev = jnp.swapaxes(E, 0, 1)
+    node_key = _node_key(cfg, group_ids)
+    lo = jnp.full((P, G), cfg.min_timeout, jnp.int32)
+    hi = jnp.full((P, G), cfg.max_timeout, jnp.int32)
+
+    def draw(term):
+        return kernels.timeout_draw(node_key, term.astype(jnp.uint32), lo, hi)
+
+    promotable = st.voter_mask | st.outgoing_mask
+    member = promotable | st.learner_mask
+    ee, hb, want_campaign, want_heartbeat, want_cq = kernels.tick_kernel(
+        st.state,
+        st.election_elapsed,
+        st.heartbeat_elapsed,
+        st.randomized_timeout,
+        promotable,
+        cfg.election_tick,
+        cfg.heartbeat_tick,
+    )
+    RA = st.recent_active  # bool[P, P, G]
+    state0, leader0 = st.state, st.leader_id
+
+    # ---- check-quorum boundary, at tick time (reference: raft.rs
+    # tick_heartbeat 1051-1079 + step_leader MsgCheckQuorum): the
+    # MsgCheckQuorum step reads-and-clears the flags whenever the boundary
+    # fires; without an active quorum the leader becomes a follower at its
+    # OWN term (vote kept, leader_id cleared, hb zeroed by reset; the
+    # (node, term)-keyed timeout redraw is idempotent) and tick_heartbeat
+    # returns before MsgBeat — the boundary round's heartbeat is
+    # suppressed.
+    if cq:
+        qa = kernels.check_quorum_active(
+            RA, st.voter_mask, st.outgoing_mask
+        )
+        cq_dep = want_cq & ~qa
+        RA = jnp.where(want_cq[:, None, :], eye_pp, RA)
+        state0 = jnp.where(cq_dep, ROLE_FOLLOWER, state0)
+        leader0 = jnp.where(cq_dep, 0, leader0)
+        hb = jnp.where(cq_dep, 0, hb)
+        want_heartbeat = want_heartbeat & ~cq_dep
+    else:
+        cq_dep = jnp.zeros((P, G), bool)
+
+    # ---- campaign local effects.  Real: become_candidate (term+1, vote
+    # self, redraw).  Pre-vote: become_pre_candidate touches ONLY the role
+    # and leader_id (reference: raft.rs:1124-1143) — term/vote/timeout
+    # stay; the request goes out at term+1.
+    if pv:
+        term = st.term
+        state = jnp.where(
+            want_campaign, kernels.ROLE_PRE_CANDIDATE, state0
+        )
+        vote = st.vote
+        leader_id = jnp.where(want_campaign, 0, leader0)
+        rt = st.randomized_timeout
+        req_term = term + want_campaign.astype(jnp.int32)
+    else:
+        term = st.term + want_campaign.astype(jnp.int32)
+        state = jnp.where(want_campaign, ROLE_CANDIDATE, state0)
+        vote = jnp.where(want_campaign, self_id, st.vote)
+        leader_id = jnp.where(want_campaign, 0, leader0)
+        rt = jnp.where(want_campaign, draw(term), st.randomized_timeout)
+        req_term = term
+
+    req = want_campaign
+    hb_send = want_heartbeat
+    sender_ids = jnp.arange(P, dtype=jnp.int32)
+
+    n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+    n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+    q_i = n_i // 2 + 1
+    q_o = n_o // 2 + 1
+
+    def in_lease(Ld, EE):
+        if not cq:  # graftcheck: allow-no-python-branch-on-traced — closes over the static SimConfig damping flag (trace-time constant)
+            return jnp.zeros((P, G), bool)
+        return (Ld != 0) & (EE < et)
+
+    def _merge_agree(agree_pl, in_s, new_last, lead_row):
+        """Pairwise-agreement update after wholesale adoption: everyone
+        in the sync set `in_s` now holds exactly the sender's log (length
+        `new_last`); agreement with outsiders is the sender's own row
+        `lead_row` (the shared idiom of every append wave)."""
+        return jnp.where(
+            in_s[:, None, :] & in_s[None, :, :],
+            new_last[None, None, :],
+            jnp.where(
+                in_s[:, None, :],
+                lead_row[None, :, :],
+                jnp.where(
+                    in_s[None, :, :], lead_row[:, None, :], agree_pl
+                ),
+            ),
+        )
+
+    def _cut_before(eff, axis):
+        """True strictly AFTER the first effective nudge along `axis` —
+        the response-stream cutoff: a deposed sender ignores everything
+        later in its v-ordered stream."""
+        c = jnp.cumsum(eff.astype(jnp.int32), axis=axis)
+        return (c - eff.astype(jnp.int32)) > 0
+
+    # ---- wave 1: heartbeats + (pre-)vote requests, per receiver in
+    # sender order.  Mirrors _linked_step's wave 1 plus the damping
+    # branches: lease ignores, lower-term nudges, and pre-vote's
+    # no-bump/no-record grant rule.
+    def _w1_body(carry, xs):
+        T, V, Ld, St, EE, HB, RT, C = carry
+        (d, hb_s, req_s, t_row, rqt_row, m_row, c_row, lt_row, li_row,
+         agree_row, sid) = xs
+        t_s = t_row[None, :]
+        # Heartbeat from s.
+        h_del = d & hb_s[None, :] & member
+        h_bump = h_del & (t_s > T)
+        h_acc = h_del & (t_s >= T)
+        h_ndg = h_del & (t_s < T)  # the low-term nudge
+        h_ndg_t = jnp.where(h_ndg, T, 0)
+        T = jnp.where(h_bump, t_s, T)
+        V = jnp.where(h_bump, 0, V)
+        St = jnp.where(h_acc, ROLE_FOLLOWER, St)
+        Ld = jnp.where(h_acc, sid + 1, Ld)
+        EE = jnp.where(h_acc, 0, EE)
+        HB = jnp.where(h_bump, 0, HB)
+        RT = jnp.where(h_bump, draw(T), RT)
+        hb_val = jnp.minimum(m_row, c_row[None, :])
+        C = jnp.where(h_acc, jnp.maximum(C, hb_val), C)
+        # (Pre-)vote request from s at rqt_row.
+        rq = rqt_row[None, :]
+        r_del = d & req_s[None, :] & promotable
+        leased = r_del & (rq > T) & in_lease(Ld, EE)
+        open_rq = r_del & ~leased
+        if pv:  # graftcheck: allow-no-python-branch-on-traced — closes over the static SimConfig damping flag (trace-time constant)
+            # Pre-vote: no term bump, no vote record, no timer reset.
+            at_hi = open_rq & (rq > T)
+            at_eq = open_rq & (rq == T)
+            can = at_hi | (
+                at_eq & ((V == sid + 1) | ((V == 0) & (Ld == 0)))
+            )
+            up = (lt_row[None, :] > st.last_term) | (
+                (lt_row[None, :] == st.last_term)
+                & (li_row[None, :] >= st.last_index)
+            )
+            g = can & up
+            rej_cv = (at_hi | at_eq) & ~g  # reject w/ commit info
+            rej_lo = open_rq & (rq < T)  # explicit low-term reject
+            snap = jnp.where(rej_cv, C, 0)
+            vff = (
+                rej_cv
+                & (St != ROLE_LEADER)
+                & (c_row[None, :] > C)
+                & (c_row[None, :] <= agree_row)
+            )
+            C = jnp.where(vff, c_row[None, :], C)
+            resp = g | rej_cv | rej_lo
+            resp_t = jnp.where(g, rq, T)
+            ys = (g, resp, snap, resp_t, h_acc, h_ndg, h_ndg_t)
+        else:
+            bump = open_rq & (rq > T)
+            T = jnp.where(bump, rq, T)
+            V = jnp.where(bump, 0, V)
+            Ld = jnp.where(bump, 0, Ld)
+            St = jnp.where(bump, ROLE_FOLLOWER, St)
+            EE = jnp.where(bump, 0, EE)
+            HB = jnp.where(bump, 0, HB)
+            RT = jnp.where(bump, draw(T), RT)
+            at = open_rq & (T == rq)
+            up = (lt_row[None, :] > st.last_term) | (
+                (lt_row[None, :] == st.last_term)
+                & (li_row[None, :] >= st.last_index)
+            )
+            g = at & (V == 0) & (Ld == 0) & up
+            rej = at & ~g
+            snap = C
+            vff = (
+                rej
+                & (St != ROLE_LEADER)
+                & (c_row[None, :] > C)
+                & (c_row[None, :] <= agree_row)
+            )
+            V = jnp.where(g, sid + 1, V)
+            EE = jnp.where(g, 0, EE)
+            C = jnp.where(vff, c_row[None, :], C)
+            ys = (g, at, snap, h_acc, h_ndg, h_ndg_t)
+        return (T, V, Ld, St, EE, HB, RT, C), ys
+
+    w1_carry, w1_ys = jax.lax.scan(
+        _w1_body,
+        (term, vote, leader_id, state, ee, hb, rt, st.commit),
+        (
+            E, hb_send, req, term, req_term, st.matched, st.commit,
+            st.last_term, st.last_index, st.agree, sender_ids,
+        ),
+    )
+    (T, V, Ld, St, EE, HB, RT, C) = w1_carry
+    if pv:
+        (p_grants, p_resps, p_snap, p_resp_t, hb_accs, hb_ndg,
+         hb_ndg_t) = w1_ys
+    else:
+        (grants, resps, rej_snap, hb_accs, hb_ndg, hb_ndg_t) = w1_ys
+
+    # ---- wave 2a: heartbeat responses + nudges back at each leader, in
+    # receiver order.  Closed form: the first nudge whose term beats the
+    # leader's cuts off every later response (handle_heartbeat_response
+    # only runs while Leader at the response's term); the deposed leader's
+    # final term is the max of the effective nudge terms.
+    t_tick = term  # each sender's tick-time term (pre-wave planes)
+    eff_hn = hb_ndg & Erev & (hb_ndg_t > T[:, None, :])
+    resumed2 = (
+        hb_accs
+        & Erev
+        & ~_cut_before(eff_hn, axis=1)
+        & (T == t_tick)[:, None, :]
+        & (St == ROLE_LEADER)[:, None, :]
+    )
+    RA = jnp.where(resumed2, True, RA)
+    cu = resumed2 & (st.matched < st.last_index[:, None, :])
+    hdep_t = jnp.max(jnp.where(eff_hn, hb_ndg_t, 0), axis=1)  # [P, G]
+    hdep = jnp.any(eff_hn, axis=1)
+    T = jnp.where(hdep, jnp.maximum(T, hdep_t), T)
+    V = jnp.where(hdep, 0, V)
+    St = jnp.where(hdep, ROLE_FOLLOWER, St)
+    Ld = jnp.where(hdep, 0, Ld)
+    EE = jnp.where(hdep, 0, EE)
+    HB = jnp.where(hdep, 0, HB)
+    RT = jnp.where(hdep, draw(T), RT)
+
+    # ---- real-election tally (the _linked_step wave-2 machinery): used
+    # at wave 2 without pre-vote, at wave 4 with it.
+    def _tally_inner(carry, xs):
+        cnt_i, cnt_o, rec_i, rec_o, ff = carry
+        dg_v, dr_v, snap_v, agree_v, vm_v, om_v = xs
+        won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
+            (cnt_o >= q_o) | (n_o == 0)
+        )
+        lost_before = ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)) | (
+            (n_o > 0) & (cnt_o + (n_o - rec_o) < q_o)
+        )
+        ok = dr_v & ~won_before & ~lost_before & (snap_v <= agree_v)
+        ff = jnp.where(ok, jnp.maximum(ff, snap_v), ff)
+        resp_v = dg_v | dr_v
+        rec_i = rec_i + (resp_v & vm_v).astype(jnp.int32)
+        rec_o = rec_o + (resp_v & om_v).astype(jnp.int32)
+        cnt_i = cnt_i + (dg_v & vm_v).astype(jnp.int32)
+        cnt_o = cnt_o + (dg_v & om_v).astype(jnp.int32)
+        return (cnt_i, cnt_o, rec_i, rec_o, ff), ()
+
+    def _real_tally(C, cand_active, t_grants, t_resps, t_snap, agree_pl):
+        """Per-candidate voter-order tally -> (C', won, lost)."""
+
+        def body(C, xs):
+            (act_s, grants_s, resps_s, snap_s, erev_s, agree_s, vm_row,
+             om_row, sid) = xs
+            del_g = grants_s & erev_s
+            del_r = (resps_s & ~grants_s) & erev_s
+            cnt_i = (act_s & vm_row).astype(jnp.int32)
+            cnt_o = (act_s & om_row).astype(jnp.int32)
+            (cnt_i, cnt_o, rec_i, rec_o, ff), _ = jax.lax.scan(
+                _tally_inner,
+                (cnt_i, cnt_o, cnt_i, cnt_o, jnp.zeros((G,), jnp.int32)),
+                (
+                    del_g, del_r, snap_s, agree_s, st.voter_mask,
+                    st.outgoing_mask,
+                ),
+            )
+            won_ci = (
+                act_s
+                & ((cnt_i >= q_i) | (n_i == 0))
+                & ((cnt_o >= q_o) | (n_o == 0))
+            )
+            lost_ci = (
+                act_s
+                & ~won_ci
+                & (
+                    ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i))
+                    | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
+                )
+            )
+            row = jax.lax.dynamic_index_in_dim(C, sid, 0, keepdims=False)
+            C = jnp.where(p_idx == sid, jnp.maximum(row, ff)[None, :], C)
+            return C, (won_ci, lost_ci)
+
+        C, (won, lost) = jax.lax.scan(
+            body,
+            C,
+            (
+                cand_active, t_grants, t_resps, t_snap, Erev, agree_pl,
+                st.voter_mask, st.outgoing_mask, sender_ids,
+            ),
+        )
+        return C, won, lost
+
+    if not pv:
+        # ---- wave 2b: the real tally now, exactly like _linked_step.
+        cand_active = req & (St == ROLE_CANDIDATE)
+        C, won, lost = _real_tally(
+            C, cand_active, grants, resps, rej_snap, st.agree
+        )
+        real_req = jnp.zeros((P, G), bool)
+        rqt2 = req_term  # unused senders masked off
+    else:
+        # ---- wave 2b: pre-vote tally.  Responses in voter order; a
+        # reject at a term above the candidate's CURRENT term deposes it
+        # (become_follower at the response term, chainable), a reject at
+        # exactly its pre-campaign term records a poll rejection, grants
+        # record while undecided; on quorum the pre-winner runs
+        # campaign(Election) — term+1, vote self, timers reset — and its
+        # REAL vote broadcast is queued for wave 3.  Deposition after the
+        # win knocks the fresh candidate back down (its queued broadcast
+        # still delivers).
+        t_c0 = term  # pre-campaign terms
+
+        def _pre_inner(carry, xs):
+            (cnt_i, cnt_o, rec_i, rec_o, ff, won_f, lost_f, dep_f,
+             cur_t) = carry
+            dg_v, dr_v, rt_v, snap_v, agree_v, vm_v, om_v, t0_row = xs
+            won_before = won_f
+            lost_before = lost_f
+            dep_now = dr_v & (rt_v > cur_t)
+            undecided = ~dep_f & ~won_before & ~lost_before
+            rec_grant = dg_v & undecided
+            rec_rej = dr_v & (rt_v == t0_row) & undecided
+            ok = rec_rej & (snap_v <= agree_v)
+            ff = jnp.where(ok, jnp.maximum(ff, snap_v), ff)
+            cnt_i = cnt_i + (rec_grant & vm_v).astype(jnp.int32)
+            cnt_o = cnt_o + (rec_grant & om_v).astype(jnp.int32)
+            resp_v = rec_grant | rec_rej
+            rec_i = rec_i + (resp_v & vm_v).astype(jnp.int32)
+            rec_o = rec_o + (resp_v & om_v).astype(jnp.int32)
+            won_now = (
+                rec_grant
+                & ((cnt_i >= q_i) | (n_i == 0))
+                & ((cnt_o >= q_o) | (n_o == 0))
+            )
+            lost_now = rec_rej & (
+                ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i))
+                | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
+            )
+            cur_t = jnp.where(won_now, t0_row + 1, cur_t)
+            won_f = won_f | won_now
+            lost_f = lost_f | lost_now
+            dep_f = dep_f | dep_now
+            cur_t = jnp.where(dep_now, jnp.maximum(cur_t, rt_v), cur_t)
+            return (
+                cnt_i, cnt_o, rec_i, rec_o, ff, won_f, lost_f, dep_f,
+                cur_t,
+            ), ()
+
+        def _pre_body(carry, xs):
+            C, T, V, St, Ld, EE, HB, RT = carry
+            (act_s, grants_s, resps_s, snap_s, respt_s, erev_s, agree_s,
+             vm_row, om_row, t0_row, sid) = xs
+            del_g = grants_s & erev_s
+            del_r = (resps_s & ~grants_s) & erev_s
+            cnt_i = (act_s & vm_row).astype(jnp.int32)
+            cnt_o = (act_s & om_row).astype(jnp.int32)
+            won0 = (
+                act_s
+                & ((cnt_i >= q_i) | (n_i == 0))
+                & ((cnt_o >= q_o) | (n_o == 0))
+            )
+            cur0 = jnp.where(won0, t0_row + 1, t0_row)
+            (cnt_i, cnt_o, rec_i, rec_o, ff, won_f, lost_f, dep_f,
+             cur_t), _ = jax.lax.scan(
+                _pre_inner,
+                (
+                    cnt_i, cnt_o, cnt_i, cnt_o,
+                    jnp.zeros((G,), jnp.int32), won0,
+                    jnp.zeros((G,), bool), jnp.zeros((G,), bool), cur0,
+                ),
+                (
+                    del_g, del_r, respt_s, snap_s, agree_s,
+                    st.voter_mask, st.outgoing_mask,
+                    jnp.broadcast_to(t0_row, (P, G)),
+                ),
+            )
+            won_f = won_f & act_s
+            lost_f = lost_f & act_s
+            dep_f = dep_f & act_s
+            # End-of-wave state for candidate row sid.
+            row = jax.lax.dynamic_index_in_dim(C, sid, 0, keepdims=False)
+            C = jnp.where(p_idx == sid, jnp.maximum(row, ff)[None, :], C)
+            t_new = jnp.where(act_s, cur_t, jnp.take(T, sid, axis=0))
+            bumped = act_s & (cur_t != t0_row)
+            v_new = jnp.where(
+                won_f & ~dep_f,
+                sid + 1,
+                jnp.where(
+                    dep_f & bumped, 0, jnp.take(V, sid, axis=0)
+                ),
+            )
+            st_new = jnp.where(
+                won_f & ~dep_f,
+                ROLE_CANDIDATE,
+                jnp.where(
+                    dep_f | lost_f,
+                    ROLE_FOLLOWER,
+                    jnp.take(St, sid, axis=0),
+                ),
+            )
+            settled = won_f | lost_f | dep_f
+            ee_new = jnp.where(settled, 0, jnp.take(EE, sid, axis=0))
+            hb_new = jnp.where(settled, 0, jnp.take(HB, sid, axis=0))
+            rt_new = jnp.where(
+                won_f | dep_f,
+                kernels.timeout_draw(
+                    jnp.take(node_key, sid, axis=0),
+                    t_new.astype(jnp.uint32),
+                    jnp.take(lo, sid, axis=0),
+                    jnp.take(hi, sid, axis=0),
+                ),
+                jnp.take(RT, sid, axis=0),
+            )
+            T = jnp.where(p_idx == sid, t_new[None, :], T)
+            V = jnp.where(p_idx == sid, v_new[None, :], V)
+            St = jnp.where(p_idx == sid, st_new[None, :], St)
+            EE = jnp.where(p_idx == sid, ee_new[None, :], EE)
+            HB = jnp.where(p_idx == sid, hb_new[None, :], HB)
+            RT = jnp.where(p_idx == sid, rt_new[None, :], RT)
+            return (C, T, V, St, Ld, EE, HB, RT), (won_f,)
+
+        pre_active = req & (St == kernels.ROLE_PRE_CANDIDATE)
+        (C, T, V, St, Ld, EE, HB, RT), (pre_won,) = jax.lax.scan(
+            _pre_body,
+            (C, T, V, St, Ld, EE, HB, RT),
+            (
+                pre_active, p_grants, p_resps, p_snap, p_resp_t, Erev,
+                st.agree, st.voter_mask, st.outgoing_mask, t_c0,
+                sender_ids,
+            ),
+        )
+        real_req = pre_won  # broadcasts queued at win time
+        rqt2 = t_c0 + 1
+
+    # ---- post-election (no pre-vote) / pre-wave-3 bookkeeping.
+    if not pv:
+        li2 = st.last_index + won.astype(jnp.int32)
+        lt2 = jnp.where(won, term, st.last_term)
+        TS = jnp.where(won, li2, st.term_start_index)
+        St = jnp.where(won, ROLE_LEADER, St)
+        Ld = jnp.where(won, self_id, Ld)
+        RT = jnp.where(won | lost, draw(T), RT)
+        EE = jnp.where(won | lost, 0, EE)
+        HB = jnp.where(won, 0, HB)
+        St = jnp.where(lost, ROLE_FOLLOWER, St)
+        matched3 = jnp.where(won[:, None, :], 0, st.matched)
+        matched3 = jnp.where(
+            won[:, None, :] & eye_pp, li2[:, None, :], matched3
+        )
+        RA = jnp.where(won[:, None, :], False, RA)
+        noop_w3 = won
+    else:
+        li2 = st.last_index
+        lt2 = st.last_term
+        TS = st.term_start_index
+        matched3 = st.matched
+        noop_w3 = jnp.zeros((P, G), bool)
+        won = jnp.zeros((P, G), bool)
+
+    agree_run = st.agree
+    LI = li2
+    LT = lt2
+    C_send = C  # commit snapshots for wave-3 sends
+
+    # ---- wave 3: appends (winner noops + catch-ups) and — with pre-vote
+    # — the REAL vote requests, per receiver in sender order.  Acks and
+    # nudges are collected for the wave-4 fold; grants/rejects for the
+    # wave-4 tally.
+    def _w3_body(carry, xs):
+        T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run = carry
+        (e_s, erev_s, cu_s, noop_s, li_row, li2_row, lt2_row, csend_row,
+         t_row, m0_row, ts_row, rr_s, rqt2_row, rli_row, rlt_row, rc_row,
+         sid) = xs
+        agree_s = jax.lax.dynamic_index_in_dim(
+            agree_run, sid, 0, keepdims=False
+        )
+        dmask = e_s & member & (noop_s[None, :] | cu_s)
+        msg = dmask & (t_row[None, :] >= T)
+        ndg = dmask & (t_row[None, :] < T)
+        ndg_t = jnp.where(ndg, T, 0)
+        # First-probe prev: a member never acked since this owner's
+        # election (matched == 0) still probes from the election noop
+        # (next stuck at term_start), everyone else from the owner's
+        # current last (Replicate's optimistic next).  Adoption WITHOUT a
+        # probe match needs the reject/decr retry chain — deferred to the
+        # post-wave retry pass, because a mid-round deposition (a nudge
+        # from a receiver earlier in this very response stream, or a
+        # higher-term message) kills the chain at the scalar leader.
+        prev_row = jnp.where(
+            m0_row == 0, ts_row[None, :] - 1, li2_row[None, :]
+        )
+        probe_ok = agree_s >= prev_row
+        retry_cand = msg & ~probe_ok & erev_s & ~_cut_before(
+            ndg & erev_s, axis=0
+        )
+        adopt = msg & probe_ok
+        bump = msg & (t_row[None, :] > T)
+        T = jnp.where(msg, t_row[None, :], T)
+        V = jnp.where(bump, 0, V)
+        St = jnp.where(msg, ROLE_FOLLOWER, St)
+        Ld = jnp.where(msg, sid + 1, Ld)
+        EE = jnp.where(msg, 0, EE)
+        HB = jnp.where(bump, 0, HB)
+        RT = jnp.where(bump, draw(T), RT)
+        C = jnp.where(adopt, jnp.maximum(C, csend_row[None, :]), C)
+        ack = adopt & erev_s
+        sent_any = jnp.any(adopt, axis=0)
+        in_s = adopt | ((p_idx == sid) & sent_any[None, :])
+        agree_run = _merge_agree(agree_run, in_s, li2_row, agree_s)
+        LI = jnp.where(adopt, li2_row[None, :], LI)
+        LT = jnp.where(adopt, lt2_row[None, :], LT)
+        if pv:  # graftcheck: allow-no-python-branch-on-traced — closes over the static SimConfig damping flag (trace-time constant)
+            # The pre-winner's REAL vote request, after s's appends (a
+            # sender is a candidate or a leader, never both; the shared
+            # scan position keeps cross-sender order).
+            rq = rqt2_row[None, :]
+            r_del = e_s & rr_s[None, :] & promotable
+            leased = r_del & (rq > T) & in_lease(Ld, EE)
+            open_rq = r_del & ~leased
+            rbump = open_rq & (rq > T)
+            T = jnp.where(rbump, rq, T)
+            V = jnp.where(rbump, 0, V)
+            Ld = jnp.where(rbump, 0, Ld)
+            St = jnp.where(rbump, ROLE_FOLLOWER, St)
+            EE = jnp.where(rbump, 0, EE)
+            HB = jnp.where(rbump, 0, HB)
+            RT = jnp.where(rbump, draw(T), RT)
+            at = open_rq & (T == rq)
+            up = (rlt_row[None, :] > LT) | (
+                (rlt_row[None, :] == LT) & (rli_row[None, :] >= LI)
+            )
+            g = at & (V == 0) & (Ld == 0) & up
+            rej = at & ~g
+            snap = C
+            vff = (
+                rej
+                & (St != ROLE_LEADER)
+                & (rc_row[None, :] > C)
+                & (rc_row[None, :] <= agree_s)
+            )
+            V = jnp.where(g, sid + 1, V)
+            EE = jnp.where(g, 0, EE)
+            C = jnp.where(vff, rc_row[None, :], C)
+            ys = (ack, ndg, ndg_t, retry_cand, g, at, snap)
+        else:
+            ys = (ack, ndg, ndg_t, retry_cand)
+        return (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run), ys
+
+    w3_carry, w3_ys = jax.lax.scan(
+        _w3_body,
+        (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run),
+        (
+            E, Erev, cu, noop_w3, st.last_index, li2, lt2, C_send, term,
+            matched3, TS,
+            real_req, rqt2, st.last_index, st.last_term, C_send,
+            sender_ids,
+        ),
+    )
+    (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run) = w3_carry
+    if pv:
+        (ack3, ndg3, ndg3_t, retry3, r_grants, r_resps, r_snap) = w3_ys
+    else:
+        (ack3, ndg3, ndg3_t, retry3) = w3_ys
+    # Wave-4 survival of the wave-3 retry chains: the reject is processed
+    # at the sender only while it is still the same-term leader (wave-2/3
+    # depositions show in the planes; same-stream nudge cutoffs are
+    # already inside retry3).
+    retry3_fire = (
+        retry3 & ((T == term) & (St == ROLE_LEADER))[:, None, :]
+    )
+
+    # ---- generic ack/nudge stage fold (waves 4 and 6): per sender, acks
+    # and nudge responses interleave in receiver order; the first
+    # effective nudge deposes the sender and drops every later ack.
+    def _stage_fold(T, V, St, Ld, EE, HB, RT, RA, matched3, C, ack, ndg,
+                    ndg_t, sent_term, sent_idx):
+        eff_n = ndg & Erev & (ndg_t > T[:, None, :])
+        was_lead = St == ROLE_LEADER
+        ack_eff = (
+            ack
+            & ~_cut_before(eff_n, axis=1)
+            & (T == sent_term)[:, None, :]
+            & was_lead[:, None, :]
+        )
+        matched3 = jnp.where(
+            ack_eff,
+            jnp.maximum(matched3, sent_idx[:, None, :]),
+            matched3,
+        )
+        RA = jnp.where(ack_eff, True, RA)
+        dep_t = jnp.max(jnp.where(eff_n, ndg_t, 0), axis=1)
+        dep = jnp.any(eff_n, axis=1)
+        T = jnp.where(dep, jnp.maximum(T, dep_t), T)
+        V = jnp.where(dep, 0, V)
+        St = jnp.where(dep, ROLE_FOLLOWER, St)
+        Ld = jnp.where(dep, 0, Ld)
+        EE = jnp.where(dep, 0, EE)
+        HB = jnp.where(dep, 0, HB)
+        RT = jnp.where(dep, draw(T), RT)
+        # Per-owner quorum commit off the cutoff rows (the term gate is
+        # maybe_commit's own-term check); commits reached before a
+        # mid-stream deposition stand.
+        mci = jnp.minimum(
+            kernels.committed_index(
+                jnp.swapaxes(matched3, 1, 2),
+                jnp.swapaxes(
+                    jnp.broadcast_to(
+                        st.voter_mask[None, :, :], (P, P, G)
+                    ), 1, 2,
+                ),
+            ),
+            kernels.committed_index(
+                jnp.swapaxes(matched3, 1, 2),
+                jnp.swapaxes(
+                    jnp.broadcast_to(
+                        st.outgoing_mask[None, :, :], (P, P, G)
+                    ), 1, 2,
+                ),
+            ),
+        )  # [P_owner, G]
+        ok = was_lead & (mci >= TS) & (mci < kernels.INF)
+        c_new = jnp.where(ok, jnp.maximum(C, mci), C)
+        adv = c_new > C
+        return T, V, St, Ld, EE, HB, RT, RA, matched3, c_new, adv
+
+    # ---- wave 4: with pre-vote, the REAL tally (plus its winner
+    # effects); both modes run the stage fold over the wave-3 acks.
+    (T, V, St, Ld, EE, HB, RT, RA, matched3, C, adv) = _stage_fold(
+        T, V, St, Ld, EE, HB, RT, RA, matched3, C, ack3, ndg3, ndg3_t,
+        term, li2,
+    )
+    if pv:
+        cand_active = real_req & (St == ROLE_CANDIDATE)
+        C, won, lost = _real_tally(
+            C, cand_active, r_grants, r_resps, r_snap, agree_run
+        )
+        li2 = LI + won.astype(jnp.int32)
+        lt2 = jnp.where(won, T, lt2)
+        TS = jnp.where(won, li2, TS)
+        St = jnp.where(won, ROLE_LEADER, St)
+        Ld = jnp.where(won, self_id, Ld)
+        RT = jnp.where(won | lost, draw(T), RT)
+        EE = jnp.where(won | lost, 0, EE)
+        HB = jnp.where(won, 0, HB)
+        St = jnp.where(lost, ROLE_FOLLOWER, St)
+        matched3 = jnp.where(won[:, None, :], 0, matched3)
+        matched3 = jnp.where(
+            won[:, None, :] & eye_pp, li2[:, None, :], matched3
+        )
+        RA = jnp.where(won[:, None, :], False, RA)
+        LI = jnp.where(won, li2, LI)
+        LT = jnp.where(won, lt2, LT)
+
+    # ---- retry resends (the maybe_decr/fast-reject chain): a surviving
+    # sender's resend carries prev at the receiver's conflict point, so it
+    # lands as wholesale adoption one wave after the reject.  Applied
+    # per sender in index order (resends of different leaders interleave
+    # sender-ordered like every wave).
+    def _apply_retry(fire, t_send, li_a, lt_a, csend_a, planes):
+        # lax.scan over the stacked sender rows (NOT an unrolled python
+        # loop: the per-sender body traces once — the PR 6 jaxpr-size
+        # discipline; compile time is tier-1 budget).  T is read-only
+        # here: a resend is accepted only at equal term, and acceptance
+        # never bumps.
+        T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run = planes
+
+        def body(carry, xs):
+            St, Ld, EE, C, LI, LT, agree_run = carry
+            f_s, t_row, li_row, lt_row, cs_row, sid = xs
+            acc = f_s & (t_row[None, :] >= T)
+            St = jnp.where(acc, ROLE_FOLLOWER, St)
+            Ld = jnp.where(acc, sid + 1, Ld)
+            EE = jnp.where(acc, 0, EE)
+            LI = jnp.where(acc, li_row[None, :], LI)
+            LT = jnp.where(acc, lt_row[None, :], LT)
+            C = jnp.where(acc, jnp.maximum(C, cs_row[None, :]), C)
+            sent_any = jnp.any(acc, axis=0)
+            in_s = acc | ((p_idx == sid) & sent_any[None, :])
+            lead_row = jax.lax.dynamic_index_in_dim(
+                agree_run, sid, 0, keepdims=False
+            )
+            agree_run = _merge_agree(agree_run, in_s, li_row, lead_row)
+            return (St, Ld, EE, C, LI, LT, agree_run), (acc,)
+
+        (St, Ld, EE, C, LI, LT, agree_run), (acc_all,) = jax.lax.scan(
+            body,
+            (St, Ld, EE, C, LI, LT, agree_run),
+            (fire, t_send, li_a, lt_a, csend_a, sender_ids),
+        )
+        return acc_all, (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run)
+
+    retry3_acc, (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run) = (
+        _apply_retry(
+            retry3_fire, term, li2, lt2, C_send,
+            (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run),
+        )
+    )
+
+    # ---- wave 5: commit-advance re-broadcasts (pass 2) and — with
+    # pre-vote — the winners' noop broadcasts, one sender-ordered scan.
+    C_send5 = C
+
+    def _w5_body(carry, xs):
+        T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run = carry
+        (e_s, erev_s, adv_s, res_s, noop_s, m3_row, li_row, li2_row,
+         lt2_row, csend_row, t_row, ts_row, sid) = xs
+        agree_s = jax.lax.dynamic_index_in_dim(
+            agree_run, sid, 0, keepdims=False
+        )
+        rb = e_s & member & adv_s[None, :] & ((m3_row > 0) | res_s)
+        noop_d = e_s & member & noop_s[None, :]
+        dmask = rb | noop_d
+        msg = dmask & (t_row[None, :] >= T)
+        ndg = dmask & (t_row[None, :] < T)
+        ndg_t = jnp.where(ndg, T, 0)
+        prev_row = jnp.where(
+            m3_row == 0, ts_row[None, :] - 1, li_row[None, :]
+        )
+        probe_ok = agree_s >= prev_row
+        retry_cand = msg & ~probe_ok & erev_s & ~_cut_before(
+            ndg & erev_s, axis=0
+        )
+        adopt = msg & probe_ok
+        bump = msg & (t_row[None, :] > T)
+        T = jnp.where(msg, t_row[None, :], T)
+        V = jnp.where(bump, 0, V)
+        St = jnp.where(msg, ROLE_FOLLOWER, St)
+        Ld = jnp.where(msg, sid + 1, Ld)
+        EE = jnp.where(msg, 0, EE)
+        HB = jnp.where(bump, 0, HB)
+        RT = jnp.where(bump, draw(T), RT)
+        C = jnp.where(
+            adopt & noop_d, jnp.maximum(C, csend_row[None, :]), C
+        )
+        LI = jnp.where(adopt, li2_row[None, :], LI)
+        LT = jnp.where(adopt, lt2_row[None, :], LT)
+        ack = adopt & erev_s
+        sent_any = jnp.any(adopt, axis=0)
+        in_s = adopt | ((p_idx == sid) & sent_any[None, :])
+        agree_run = _merge_agree(agree_run, in_s, li2_row, agree_s)
+        return (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run), (
+            ack, ndg, ndg_t, retry_cand,
+        )
+
+    # prev for the probe check: re-broadcasts carry prev = the leader's
+    # current last (li2, the noop included for a fresh winner); a pre-vote
+    # winner's noop carries prev = its pre-noop cursor.
+    if pv:
+        w5_prev = jnp.where(won, li2 - 1, li2)
+        w5_noop = won
+        sent_term5 = jnp.where(won, rqt2, term)
+    else:
+        w5_prev = li2
+        w5_noop = jnp.zeros((P, G), bool)
+        sent_term5 = term
+    (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run), (
+        ack5, ndg5, ndg5_t, retry5,
+    ) = jax.lax.scan(
+        _w5_body,
+        (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run),
+        (
+            E, Erev, adv, resumed2, w5_noop,
+            matched3, w5_prev, li2, lt2, C_send5,
+            sent_term5, TS, sender_ids,
+        ),
+    )
+    # Wave-5 retry chains: survival gate, then the resends land as
+    # wholesale adoption; their acks fold into the wave-6 stage together
+    # with the wave-3 chains' (the undamped path collapses the same
+    # chains into its commit stages).
+    retry5_fire = (
+        retry5 & ((T == sent_term5) & (St == ROLE_LEADER))[:, None, :]
+    )
+    retry5_acc, (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run) = (
+        _apply_retry(
+            retry5_fire, sent_term5, li2, lt2,
+            jnp.where(w5_noop, C_send5, 0),
+            (T, V, St, Ld, EE, HB, RT, C, LI, LT, agree_run),
+        )
+    )
+    ack5 = ack5 | retry3_acc | retry5_acc
+
+    # ---- wave 6: stage fold over the wave-5 acks, then the settled
+    # commit propagated to in-sync sendable members (the _commit_b
+    # approximation), whose sends draw nudges from higher-term receivers.
+    (T, V, St, Ld, EE, HB, RT, RA, matched3, C, _adv6) = _stage_fold(
+        T, V, St, Ld, EE, HB, RT, RA, matched3, C, ack5, ndg5, ndg5_t,
+        sent_term5, li2,
+    )
+    is_lead6 = St == ROLE_LEADER
+    # Compare against what each sender's APPEND sends carried: the wave-3
+    # snapshot, except a pre-vote winner's noop which carried the wave-5
+    # snapshot.
+    csend6 = jnp.where(won, C_send5, C_send) if pv else C_send
+    send6 = (
+        E
+        & member
+        & is_lead6[:, None, :]
+        & ((matched3 > 0) | resumed2)
+        & (C > csend6)[:, None, :]
+    )
+    elig6 = (
+        send6
+        & (sent_term5[:, None, :] >= T[None, :, :])
+        & ((agree_run >= li2[:, None, :]) | Erev)
+    )
+    C = jnp.maximum(
+        C,
+        jnp.max(jnp.where(elig6, C[:, None, :], 0), axis=0),
+    )
+    RA = jnp.where(elig6 & Erev, True, RA)
+    ndg6 = send6 & (sent_term5[:, None, :] < T[None, :, :]) & Erev
+    dep6_t = jnp.max(jnp.where(ndg6, T[None, :, :], 0), axis=1)
+    dep6 = jnp.any(ndg6, axis=1) & (dep6_t > T)
+    T = jnp.where(dep6, dep6_t, T)
+    V = jnp.where(dep6, 0, V)
+    St = jnp.where(dep6, ROLE_FOLLOWER, St)
+    Ld = jnp.where(dep6, 0, Ld)
+    EE = jnp.where(dep6, 0, EE)
+    HB = jnp.where(dep6, 0, HB)
+    RT = jnp.where(dep6, draw(T), RT)
+
+    # ---- the round's append workload at the acting leader, with the
+    # same nudge cutoffs on its ack stream.
+    is_leader = (St == ROLE_LEADER) & alive
+    has_leader = jnp.any(is_leader, axis=0)
+    lead_term = jnp.max(jnp.where(is_leader, T, -1), axis=0)
+    is_acting = is_leader & (T == lead_term)
+    first_l = jnp.min(jnp.where(is_acting, p_idx, P), axis=0)
+    is_acting_leader = (p_idx == first_l) & has_leader
+    n_app = jnp.where(has_leader, append_n, 0)
+    sent_b = has_leader & (n_app > 0)
+    lead_pre_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
+    LI = LI + jnp.where(is_acting_leader, n_app, 0)
+    LT = jnp.where(is_acting_leader & (n_app > 0), lead_term, LT)
+    lead_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
+    lead_last_term = jnp.max(jnp.where(is_acting_leader, LT, 0), axis=0)
+    reach_b = jnp.any(E & is_acting_leader[:, None, :], axis=0)
+    ack_path = jnp.any(E & is_acting_leader[None, :, :], axis=1)
+    acting_f = is_acting_leader.astype(jnp.int32)
+    acting_row0 = jnp.sum(
+        matched3 * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    resumed_act = jnp.any(resumed2 & is_acting_leader[:, None, :], axis=0)
+    agree_act = jnp.sum(
+        agree_run * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    pr_ok = (acting_row0 > 0) | resumed_act
+    ts_acting = jnp.sum(TS * acting_f, axis=0, dtype=jnp.int32)
+    send_w = sent_b & reach_b & member & ~is_acting_leader & pr_ok
+    sync_msg = send_w & (T <= lead_term)
+    ndg_w = send_w & (T > lead_term) & ack_path
+    ndg_w_t = jnp.where(ndg_w, T, 0)
+    cutw = _cut_before(ndg_w, axis=0)
+    # First-probe prev (never-acked members probe from the noop) or the
+    # surviving retry chain — the acting leader is deposed only by these
+    # very nudges, so ~cutw IS the survival gate.
+    probe_w = agree_act >= jnp.where(
+        acting_row0 == 0, ts_acting[None, :] - 1, lead_pre_last[None, :]
+    )
+    sync_b = sync_msg & (probe_w | (ack_path & ~cutw))
+    bump_b = sync_msg & (T < lead_term)
+    T = jnp.where(sync_msg, lead_term, T)
+    St = jnp.where(sync_msg, ROLE_FOLLOWER, St)
+    V = jnp.where(bump_b, 0, V)
+    Ld = jnp.where(sync_msg, first_l + 1, Ld)
+    EE = jnp.where(sync_msg, 0, EE)
+    HB = jnp.where(bump_b, 0, HB)
+    RT = jnp.where(bump_b, draw(T), RT)
+    LI = jnp.where(sync_b, lead_last, LI)
+    LT = jnp.where(sync_b, lead_last_term, LT)
+    in_sb = sync_b | (is_acting_leader & sent_b)
+    lead_row_b = jnp.sum(
+        agree_run * acting_f[:, None, :], axis=0, dtype=jnp.int32
+    )
+    agree_run = _merge_agree(agree_run, in_sb, lead_last, lead_row_b)
+    # Ack stream with nudge cutoffs (the acting leader's v-ordered
+    # responses; every workload nudge carries a term above lead_term, so
+    # all are effective).
+    ack_w = sync_b & ack_path & ~cutw
+    acting_row = jnp.where(
+        ack_w | (is_acting_leader & sent_b),
+        jnp.maximum(acting_row0, lead_last),
+        acting_row0,
+    )
+    matched3 = jnp.where(
+        is_acting_leader[:, None, :], acting_row[None, :, :], matched3
+    )
+    RA = jnp.where(
+        is_acting_leader[:, None, :] & ack_w[None, :, :], True, RA
+    )
+    mci_b = jnp.minimum(
+        _quorum_index(acting_row, st.voter_mask),
+        _quorum_index(acting_row, st.outgoing_mask),
+    )
+    commit_ok = sent_b & (mci_b >= ts_acting) & (mci_b < kernels.INF)
+    lead_commit_old = jnp.max(jnp.where(is_acting_leader, C, 0), axis=0)
+    lead_commit = jnp.where(
+        commit_ok, jnp.maximum(lead_commit_old, mci_b), lead_commit_old
+    )
+    C = jnp.where(is_acting_leader, lead_commit, C)
+    C = jnp.where(sync_b, jnp.maximum(C, lead_commit), C)
+    # Workload nudges depose the acting leader at round end.
+    depw_t = jnp.max(ndg_w_t, axis=0)
+    depw = jnp.any(ndg_w, axis=0) & (depw_t > lead_term)
+    dw = is_acting_leader & depw[None, :]
+    T = jnp.where(dw, depw_t[None, :], T)
+    V = jnp.where(dw, 0, V)
+    St = jnp.where(dw, ROLE_FOLLOWER, St)
+    Ld = jnp.where(dw, 0, Ld)
+    EE = jnp.where(dw, 0, EE)
+    HB = jnp.where(dw, 0, HB)
+    RT = jnp.where(dw, draw(T), RT)
+
+    out = SimState(
+        term=T,
+        state=St,
+        vote=V,
+        leader_id=Ld,
+        election_elapsed=EE,
+        heartbeat_elapsed=HB,
+        randomized_timeout=RT,
+        last_index=LI,
+        last_term=LT,
+        commit=C,
+        matched=matched3,
+        term_start_index=TS,
+        agree=agree_run,
+        voter_mask=st.voter_mask,
+        outgoing_mask=st.outgoing_mask,
+        learner_mask=st.learner_mask,
+        recent_active=RA,
+    )
+    if counters is None and health is None:
+        return out
+    extras: Tuple = ()
+    if counters is not None:
+        # campaign() calls: the tick-time campaigns plus, with pre-vote,
+        # the pre-winners' second (real) campaign call; MsgBeat steps
+        # exclude boundary-suppressed heartbeats (already folded into
+        # hb_send).
+        counters = kernels.count_events(
+            counters, want_campaign, hb_send, jnp.any(won, axis=0),
+            out.commit - st.commit,
+        )
+        if pv:
+            counters = counters.at[kernels.CTR_CAMPAIGNS].add(
+                jnp.sum(real_req, dtype=jnp.int32)
+            )
+        extras = extras + (counters,)
+    if health is not None:
+        # The oracle derives `won` from observable end-of-round state
+        # (simref.HealthOracle): Leader at round end with a fresh term or
+        # a non-Leader pre-round role — a transient winner deposed later
+        # in the same round does NOT count.  Mirror that here.
+        has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        campaigned = jnp.any(want_campaign, axis=0)
+        won_end = jnp.any(
+            (out.state == ROLE_LEADER)
+            & ((st.state != ROLE_LEADER) | (out.term > st.term)),
+            axis=0,
+        )
+        planes, pos = kernels.update_health(
+            health.planes,
+            health.window_pos,
+            cfg.health_window,
+            has_lead_end,
+            commit_adv,
+            term_bump,
+            campaigned & ~won_end,
+        )
+        extras = extras + (HealthState(planes, pos),)
+    return (out,) + extras
+
+
 def read_index(
     cfg: SimConfig,
     st: SimState,
     crashed: jnp.ndarray,  # gc: bool[P, G]
+    link: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
 ) -> jnp.ndarray:
     """Batched linearizable ReadIndex barrier, Safe mode (reference:
     read_only.rs:65-140 + raft.rs step_leader MsgReadIndex 2067-2096 +
@@ -1374,11 +2467,17 @@ def read_index(
         (commit < term_start_index — the commit_to_current_term gate), or
       * the ack quorum fails: alive members at term <= the leader's ack
         the ctx heartbeat; members at a HIGHER term silently IGNORE it —
-        with check_quorum and pre_vote both off (this sim's config) a
-        lower-term heartbeat draws no response at all (raft.rs:1299-1330),
-        so they neither ack nor depose.  Joint configs need both
-        majorities; a singleton group answers immediately without
-        heartbeats (raft.rs:2075-2079).
+        they neither ack nor (for this pure probe) depose; with
+        check_quorum on they would ALSO nudge-depose the stale leader,
+        which a probing read must not do, so the probe models the ack set
+        only (the scalar probe does perturb — parity tests probe last).
+        Joint configs need both majorities; a singleton group answers
+        immediately without heartbeats (raft.rs:2075-2079).
+
+    `link` (optional bool[P, P, G] directed reachability, the chaos
+    engine's plane) makes the barrier link-aware: an ack needs the
+    leader->member link for the ctx heartbeat AND the member->leader link
+    for the response.  None keeps the crash-mask-only graph unchanged.
 
     Pure and jittable: probing reads never mutates `st` (the scalar oracle's
     probe DOES perturb its cluster, so parity tests probe last).
@@ -1404,6 +2503,15 @@ def read_index(
     singleton = (n_i == 1) & (n_o == 0)
 
     acker = (alive & member & (st.term <= lead_term[None, :])) | acting
+    if link is not None:
+        # Link-aware barrier (DESIGN.md §7's last gap, closed by ISSUE 7):
+        # the ctx heartbeat must REACH the member (leader -> member link)
+        # and its ack must RETURN (member -> leader link); a one-way
+        # reachable member heartbeats but never acks.  `link=None` keeps
+        # the crash-mask-only graph bit-identical.
+        reach = jnp.any(link & acting[:, None, :], axis=0)  # [P_m, G]
+        ret = jnp.any(link & acting[None, :, :], axis=1)  # member -> l
+        acker = (acker & reach & ret) | acting
 
     def half_quorum(mask):
         n = jnp.sum(mask, axis=0).astype(jnp.int32)
@@ -1856,12 +2964,14 @@ class ClusterSim:
         if self._health is not None:
             self._health = init_health(self.cfg)
 
-    def read_index(self, crashed=None) -> jnp.ndarray:
-        """Batched linearizable ReadIndex barrier (see sim.read_index)."""
+    def read_index(self, crashed=None, link=None) -> jnp.ndarray:
+        """Batched linearizable ReadIndex barrier (see sim.read_index);
+        `link` threads the chaos reachability plane through the ack
+        quorum."""
         if crashed is None:
             crashed = jnp.zeros(
                 (self.cfg.n_peers, self.cfg.n_groups), bool
             )
         return jax.jit(functools.partial(read_index, self.cfg))(
-            self.state, crashed
+            self.state, crashed, link
         )

@@ -33,9 +33,11 @@ class ScalarCluster:
         """`voters`/`voters_outgoing`/`learners` (peer-id lists) bootstrap
         every group in that (possibly joint) configuration; default: all
         peers voters.  `check_quorum`/`pre_vote` configure every Raft the
-        reference way (raft.rs Config); the device sim models neither (the
-        host path handles them — see sim.py's protocol-scope note), so
-        parity schedules leave both False.  `metrics` (an optional
+        reference way (raft.rs Config); since ISSUE 7 the device sim
+        models both (SimConfig.check_quorum / pre_vote route rounds
+        through the damped wave path), so damped parity schedules set the
+        SAME flags on both sides (tests/test_damping_parity.py) while the
+        undamped suites keep both False.  `metrics` (an optional
         raft_tpu.metrics.Metrics) is shared by every Raft in the cluster —
         the scalar side of the device counter-plane parity test."""
         self.n_groups = n_groups

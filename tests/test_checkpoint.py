@@ -47,3 +47,48 @@ def test_hard_states_shape(tmp_path):
     # Everything elected and committed: terms/commits positive.
     assert (hs["term"] >= 1).all()
     assert (hs["commit"].max(axis=0) >= 1).all()
+
+
+def test_checkpoint_damped_plane_round_trip(tmp_path):
+    """The optional recent_active plane (SimConfig damping, ISSUE 7)
+    round-trips: present -> restored bit-exactly, absent -> None, and a
+    checkpoint missing a REQUIRED plane fails loudly.  State is built
+    without stepping (init + direct plane writes) so this stays
+    compile-free tier-1."""
+    import pytest
+
+    from raft_tpu.multiraft import sim as sim_mod
+
+    cfg = SimConfig(n_groups=4, n_peers=3, check_quorum=True, pre_vote=True)
+    st = sim_mod.init_state(cfg)
+    assert st.recent_active is not None
+    st = st._replace(
+        recent_active=st.recent_active.at[0, 1, :].set(True),
+        term=st.term.at[0].set(3),
+    )
+    path = os.path.join(tmp_path, "damped.npz")
+    save_state(st, path)
+    back = load_state(path)
+    for f in st._fields:
+        a, b = getattr(st, f), getattr(back, f)
+        assert (a is None) == (b is None), f
+        if a is not None:
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"field {f}"
+            )
+    assert np.asarray(back.recent_active).dtype == np.bool_
+
+    # Undamped: the plane is skipped on save and restored as None.
+    st0 = sim_mod.init_state(SimConfig(n_groups=4, n_peers=3))
+    path0 = os.path.join(tmp_path, "plain.npz")
+    save_state(st0, path0)
+    assert load_state(path0).recent_active is None
+
+    # A required plane missing is corruption, not an optional skip.
+    with np.load(path0) as data:
+        arrays = {k: data[k] for k in data.files if k != "commit"}
+    broken = os.path.join(tmp_path, "broken.npz")
+    with open(broken, "wb") as f:
+        np.savez(f, **arrays)
+    with pytest.raises(ValueError, match="missing required plane"):
+        load_state(broken)

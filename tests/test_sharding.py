@@ -5,6 +5,7 @@ to the single-device sim (shard-invariance of the batch)."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from raft_tpu.multiraft import ClusterSim, SimConfig
 from raft_tpu.multiraft import sharding
@@ -54,6 +55,10 @@ def test_global_status_collectives():
     assert status["total_commit"] >= cfg.n_groups
 
 
+@pytest.mark.slow  # ~74s: the P=5 step + sharded-barrier compiles dominate
+# the tier-1 budget (870s gate saturated — ROADMAP.md); the unsharded
+# read_index semantics stay tier-1 in test_read_index_batch.py and the
+# sharding mechanics in this file's shard-invariance cases.
 def test_sharded_read_index_matches_local():
     cfg = SimConfig(n_groups=32, n_peers=5)
     mesh = sharding.make_mesh()

@@ -1,0 +1,130 @@
+"""Before/after election-damping churn report over the chaos golden corpus.
+
+Runs every scenario in tests/testdata/chaos/plans.json twice — undamped
+and fully damped (SimConfig check_quorum + pre_vote) — through the
+compiled chaos scan (ClusterSim.run_plan) and writes one JSON document
+comparing the runs per plan:
+
+    {"groups": 128, "plans": {
+        "asymmetric-link": {
+            "undamped": {"mttr_rounds": ..., "reelections": ...,
+                         "max_term": ..., "peak_term_bumps": ...,
+                         "vote_splits": ..., "safety": {...}},
+            "damped":   {...},
+            "term_growth_ratio": 0.12}, ...}}
+
+`max_term` is the fleet max term at scenario end (every run starts from a
+fresh term-0 boot, so it IS the cumulative term growth), and
+`peak_term_bumps` / `vote_splits` are end-of-run maxima over groups of
+the PR 3 health planes.  The CI chaos step uploads the report next to
+the scenario summaries; any safety-invariant count in EITHER
+configuration exits non-zero, and so does a damped run whose term growth
+fails to undercut the undamped run on the asymmetric-link scenario — the
+churn collapse this PR exists to demonstrate.
+
+Usage:  python tools/chaos_churn_report.py [--groups N] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_config(doc: dict, groups: int, damped: bool) -> dict:
+    from raft_tpu.multiraft import ClusterSim, SimConfig, chaos, kernels
+
+    plan = chaos.plan_from_dict(doc)
+    cfg = SimConfig(
+        n_groups=groups,
+        n_peers=plan.n_peers,
+        collect_health=True,
+        check_quorum=damped,
+        pre_vote=damped,
+    )
+    sim = ClusterSim(cfg, chaos=plan)
+    report = sim.run_plan()
+    planes = np.asarray(sim._health.planes)
+    term = np.asarray(sim.state.term)
+    return {
+        "mttr_rounds": report["mttr_rounds"],
+        "reelections": report["reelections"],
+        "max_leaderless_streak": report["max_leaderless_streak"],
+        "max_term": int(term.max()),
+        "peak_term_bumps": int(planes[kernels.HP_TERM_BUMPS].max()),
+        "vote_splits": int(planes[kernels.HP_VOTE_SPLITS].max()),
+        "safety": report["safety"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--groups", type=int, default=128)
+    ap.add_argument("--out", default="chaos-churn-report.json")
+    ap.add_argument(
+        "--plans",
+        default=os.path.join(
+            os.path.dirname(__file__), "..", "tests", "testdata", "chaos",
+            "plans.json",
+        ),
+    )
+    args = ap.parse_args()
+    with open(args.plans, "r", encoding="utf-8") as f:
+        docs = json.load(f)
+    out = {"groups": args.groups, "plans": {}}
+    failed = []
+    for doc in docs:
+        name = doc["name"]
+        undamped = run_config(doc, args.groups, damped=False)
+        damped = run_config(doc, args.groups, damped=True)
+        ratio = (
+            damped["max_term"] / undamped["max_term"]
+            if undamped["max_term"]
+            else None
+        )
+        out["plans"][name] = {
+            "undamped": undamped,
+            "damped": damped,
+            "term_growth_ratio": round(ratio, 3) if ratio is not None else None,
+        }
+        for tag, rep in (("undamped", undamped), ("damped", damped)):
+            if any(rep["safety"].values()):
+                failed.append(f"{name}/{tag}: safety {rep['safety']}")
+        print(
+            f"{name}: max_term {undamped['max_term']} -> "
+            f"{damped['max_term']}, peak bumps "
+            f"{undamped['peak_term_bumps']} -> {damped['peak_term_bumps']}"
+        )
+    # The headline claim: damping collapses the asymmetric-partition term
+    # inflation (the PR 5 pinned pathology).  The scenario MUST be in the
+    # corpus — a rename would otherwise skip the gate vacuously.
+    asym = out["plans"].get("asymmetric-link")
+    if asym is None:
+        failed.append(
+            "golden corpus has no 'asymmetric-link' scenario; the churn "
+            "collapse gate cannot run (renamed plan?)"
+        )
+    elif asym["damped"]["max_term"] >= asym["undamped"]["max_term"]:
+        failed.append(
+            "asymmetric-link: damped term growth "
+            f"{asym['damped']['max_term']} did not undercut undamped "
+            f"{asym['undamped']['max_term']}"
+        )
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}")
+    if failed:
+        for msg in failed:
+            print(f"ERROR: {msg}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,29 @@ PACKED_PLANES: Dict[str, tuple] = {
     "masks": (3, "three bool planes"),
 }
 
+# Damping planes (ISSUE 7): device state added by check-quorum/pre-vote,
+# registered here so a dtype/bound change goes through this registry like
+# every other plane.  recent_active is bool[P, P, G] (1 bit, no overflow
+# surface; read-and-cleared at each owner's election-timeout boundary and
+# wholesale at become_leader — the GC007 anchor on SimState.recent_active
+# pins the dtype).  The lease predicate's tick counter operand
+# (election_elapsed) is bounded at LEADERS by election_tick (tick_kernel
+# resets at the boundary) and at followers by randomized_timeout <
+# 2*election_tick at reset sites — both fit 8 bits for election_tick <=
+# 127, which is what would let a future packed-planes pass carry them as
+# u8 lanes; they stay int32 today for the TPU-native [P, G] layout.
+#   SimState field -> (bits needed, bound derivation summary); enforced
+#   by check_sim below: every key must BE a SimState field, and
+#   recent_active's GC007 anchor must stay bool.
+DAMPING_PLANES: Dict[str, tuple] = {
+    "recent_active": (1, "bool; boundary read-and-clear + won reset"),
+    "election_elapsed": (
+        8,
+        "lease operand: < election_tick at leaders (boundary reset); "
+        "< 2*election_tick at followers (timeout redraw bound)",
+    ),
+}
+
 
 def _v(sf: SourceFile, lineno: int, message: str) -> Violation:
     return Violation(sf.display_path, lineno, GC008, GC008_SLUG, message)
@@ -310,9 +333,48 @@ def _increment_bound(
 
 def check_sim(sf: SourceFile) -> Iterator[Violation]:
     cluster: Optional[ast.ClassDef] = None
+    sim_state: Optional[ast.ClassDef] = None
     for node in ast.iter_child_nodes(sf.ast_tree):
         if isinstance(node, ast.ClassDef) and node.name == "ClusterSim":
             cluster = node
+        if isinstance(node, ast.ClassDef) and node.name == "SimState":
+            sim_state = node
+    if sim_state is not None:
+        # DAMPING_PLANES enforcement: the registered damping planes must
+        # exist as SimState fields (a rename silently orphaning a
+        # registered bound fails the build), and recent_active's anchored
+        # dtype must stay bool — the 1-bit no-overflow claim rests on it.
+        fields: Dict[str, int] = {}
+        anchors: Dict[str, str] = {}
+        for item in sim_state.body:
+            if isinstance(item, ast.AnnAssign) and isinstance(
+                item.target, ast.Name
+            ):
+                name = item.target.id
+                fields[name] = item.lineno
+                line = sf.lines[item.lineno - 1]
+                if "# gc:" in line:
+                    anchors[name] = line.split("# gc:", 1)[1].strip()
+        for name, (bits, _why) in DAMPING_PLANES.items():
+            if name not in fields:
+                yield _v(
+                    sf,
+                    sim_state.lineno,
+                    f"DAMPING_PLANES registers {name!r} but SimState has "
+                    "no such field; the registered bound is orphaned — "
+                    "rename the registry entry with the field",
+                )
+            elif name == "recent_active" and not anchors.get(
+                name, ""
+            ).startswith("bool"):
+                yield _v(
+                    sf,
+                    fields[name],
+                    "SimState.recent_active's anchor is no longer bool; "
+                    "DAMPING_PLANES registers it as a 1-bit plane with no "
+                    "overflow surface — a wider dtype needs a re-derived "
+                    "bound in the registry",
+                )
     if cluster is None:
         return
     drain_max: Optional[int] = None

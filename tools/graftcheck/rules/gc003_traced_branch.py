@@ -48,6 +48,8 @@ _STATIC_CONFIG_FIELDS = {
     "commit_stall_ticks",
     "churn_bumps",
     "health_topk",
+    "check_quorum",
+    "pre_vote",
     "min_timeout",
     "max_timeout",
 }
