@@ -38,6 +38,10 @@ Flags (all optional; defaults reproduce the BENCH_r0x methodology):
                   loss draws, the instrumented-fleet configuration.  Uses
                   election_tick=64 so the conservative (lossy) steady
                   bound leaves headroom for the K=32 fused horizon.
+  --check-quorum  election-damping configuration (check_quorum=True): the
+                  fused damped kernel (_steady_damped_kernel) since
+                  ISSUE 8, same election_tick=64 regime; composes with
+                  --lossy (see the metric-key note below).
   --groups N      shrink the batch (CI artifact runs; default 100000).
   --reps N        repetition count (>=5 for comparable medians).
   --skip-anchor   skip the native-CPU anchor (vs_baseline becomes null).
@@ -46,8 +50,16 @@ Each configuration gets its own metric key so BENCH_r* files distinguish
 which path was measured: the steady path keeps the historical
 `raft_ticks_per_sec_100k_groups_5_peers`, --health appends `_health`,
 --lossy appends `_chaos` (both when combined: `_health_chaos`), and
---check-quorum appends `_cq` (the election-damping configuration —
-always the general damped wave path; steady_mask rejects damping-on).
+--check-quorum appends `_cq_fused` (the election-damping configuration
+riding the ISSUE 8 fused damped kernel; the retired `_cq` series was the
+pre-fusion wave-replay number).  --check-quorum composes with --lossy
+(`..._chaos_cq_fused`): the lossless damped predicate proves every
+check-quorum boundary passes so the fused branch engages every block,
+while the LOSSY damped predicate must forbid in-horizon boundaries
+entirely — per-group boundary phases are spread uniformly, so at scale
+the whole-batch predicate honestly rejects and the composed run times
+the general damped wave path (the printed warning says so; a per-group
+hybrid split for damped configs is ROADMAP work).
 
 Perf-regression gate (docs/PERF.md):
 
@@ -135,12 +147,16 @@ def bench_device(
     # lossy link can drop any heartbeat, so timers are assumed
     # free-running): the election timeout must clear the fused horizon or
     # the fused branch would never engage — election_tick=64 > K=32.
-    # --check-quorum benches the DAMPED configuration: steady_mask
-    # rejects damping-on wholesale, so every round runs the general
-    # damped wave path (sim._damped_linked_step) — the honest number for
-    # a fleet running the disruption-damping protocols.
+    # --check-quorum benches the DAMPED configuration: since ISSUE 8 it
+    # rides the fused damped kernel (_steady_damped_kernel) whenever the
+    # steady predicate holds — damping uses the same free-running timer
+    # bound as chaos, so it shares the election_tick=64 > K=32 regime —
+    # and composes with --lossy (the fused damped chaos kernel).  The
+    # general damped wave path (sim._damped_linked_step) remains the
+    # lax.cond fallback.
     cfg = SimConfig(
-        n_groups=groups, n_peers=P, election_tick=64 if chaos else 10,
+        n_groups=groups, n_peers=P,
+        election_tick=64 if (chaos or check_quorum) else 10,
         check_quorum=check_quorum,
     )
     state = sim.init_state(cfg)
@@ -178,61 +194,87 @@ def bench_device(
             return out[0], out[1]
         return kstep(*args), h
 
+    # The scan carry holds the optional recent_active plane bit-packed
+    # 32:1 along G (sim.pack_ra_carry — the ISSUE 8 packed-carry form);
+    # identity (None words) for undamped configs, so their graphs are
+    # unchanged.
     if health:
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def multi_round_h(st, h, rb):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def multi_round_h(st, ra, h, rb):
             def body(carry, i):
-                s, hh = carry
-                return block_step(s, hh, rb + i * K), ()
+                s, raw, hh = carry
+                s, hh = block_step(
+                    sim.unpack_ra_carry(s, raw), hh, rb + i * K
+                )
+                s, raw = sim.pack_ra_carry(s)
+                return (s, raw, hh), ()
 
             carry, _ = jax.lax.scan(
-                body, (st, h),
+                body, (st, ra, h),
                 jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32),
             )
             return carry
 
     else:
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def multi_round(st, rb):
-            def body(s, i):
-                return block_step(s, None, rb + i * K)[0], ()
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def multi_round(st, ra, rb):
+            def body(carry, i):
+                s, raw = carry
+                s = block_step(
+                    sim.unpack_ra_carry(s, raw), None, rb + i * K
+                )[0]
+                return sim.pack_ra_carry(s), ()
 
-            st, _ = jax.lax.scan(
-                body, st, jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32)
+            carry, _ = jax.lax.scan(
+                body, (st, ra),
+                jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32),
             )
-            return st
+            return carry
 
     round_no = 0
 
-    def advance(st, h):
+    def advance(stp, ra, h):
+        """One donated scan segment over the PACKED carry: the bit-packed
+        recent_active words stay packed between segments, so the timed
+        loop never materializes the bool[P, P, G] plane — unpacking is
+        the caller's (out-of-timed-region) job."""
         nonlocal round_no
         rb = jnp.int32(round_no)
         round_no += ROUNDS_PER_SCAN
         if health:
-            return multi_round_h(st, h, rb)
-        return multi_round(st, rb), None
+            stp, ra, h = multi_round_h(stp, ra, h, rb)
+        else:
+            stp, ra = multi_round(stp, ra, rb)
+        return stp, ra, h
 
     # Warm up: compile + let the election storm settle into steady state
-    # (the chaos config's longer election_tick needs a longer settle).
-    settle = 30 if not chaos else 3 * cfg.election_tick
+    # (the chaos/damped configs' longer election_tick needs a longer
+    # settle).
+    settle = 30 if not (chaos or check_quorum) else 3 * cfg.election_tick
     for _ in range(settle):
         state = full(state, crashed, append)
     round_no = settle
-    state, hstate = advance(state, hstate)
-    jax.block_until_ready(state)
-    if chaos:
+    stp, ra = sim.pack_ra_carry(state)
+    stp, ra, hstate = advance(stp, ra, hstate)
+    jax.block_until_ready(stp)
+    if chaos or check_quorum:
         # Honesty check: the timed region must actually ride the fused
         # kernel — a rejected predicate would silently bench the general
-        # fallback instead of the chaos-on fast path.
+        # fallback instead of the fast path being labeled.  The unpack
+        # happens here, OUTSIDE the timed region; `state`'s buffers alias
+        # the carry and are donated away by the next advance, so it must
+        # not be read after the timed loop starts.
+        state = sim.unpack_ra_carry(stp, ra)
         pred = bool(
             pallas_step.steady_predicate(cfg, state, crashed, K, link)
         )
         if not pred:
             print(
-                "WARNING: steady predicate rejects the settled lossy "
-                "state; the chaos bench is timing the general fallback",
+                "WARNING: steady predicate rejects the settled "
+                f"{'lossy' if chaos else 'damped'} state; the bench is "
+                "timing the general fallback",
                 file=sys.stderr,
             )
 
@@ -247,14 +289,15 @@ def bench_device(
         for _ in range(reps):
             t0 = time.perf_counter()
             for _ in range(SCANS):
-                state, hstate = advance(state, hstate)
-            jax.block_until_ready(state)
+                stp, ra, hstate = advance(stp, ra, hstate)
+            jax.block_until_ready(stp)
             samples.append(ticks / (time.perf_counter() - t0))
     finally:
         if profile_dir:
             profiling.stop_trace()
 
     # Sanity: the protocol is actually running (leaders + commits advance).
+    state = sim.unpack_ra_carry(stp, ra)
     commit_min = int(jnp.min(jnp.max(state.commit, axis=0)))
     assert commit_min > 0, "bench sanity: no commits on device"
     if health and health_out:
@@ -527,7 +570,11 @@ def main() -> None:
     if args.lossy >= 0.0:
         metric += "_chaos"
     if args.check_quorum:
-        metric += "_cq"
+        # `_cq_fused` (ISSUE 8): the damped configuration rides the fused
+        # damped kernel now — a different series from the retired `_cq`
+        # wave-replay numbers (75.4k @ cpu@g256), kept in
+        # BENCH_baseline.json as the historical anchor.
+        metric += "_cq_fused"
     line = {
         "metric": metric,
         "value": device["median"],

@@ -50,6 +50,26 @@ here is missing from it or untested under tests/.
                                LOSS_SCALE — GC008 PACKED_PLANES); exact
                                round-trip + numpy-twin parity in
                                tests/test_multiraft_kernels.py
+  pack_bits_g              <-> simref.host_pack_bits_g (the numpy twin;
+                               exact round-trip + twin parity in
+                               tests/test_multiraft_kernels.py): 32:1
+                               GROUP-axis packing of bool planes — the
+                               recent_active scan-carry form the donated
+                               runners and the fused-damped bench carry
+                               (GC008 PACKED_PLANES family `bits_g`)
+  unpack_bits_g            <-> simref.host_unpack_bits_g (the numpy twin;
+                               round-trip + twin parity in
+                               tests/test_multiraft_kernels.py): the
+                               inverse unpack back to bool[..., G] at the
+                               step boundary
+  cq_boundary_safe         <-> the check-quorum boundary outcome over a
+                               steady horizon (the damping gate of
+                               pallas_step.steady_mask): conservative
+                               scalar twin in
+                               tests/test_multiraft_kernels.py, horizon
+                               behavior pinned end-to-end by the
+                               fused-damped parity suite
+                               tests/test_pallas_step.py
   check_safety             <-> the Raft safety arguments themselves
                                (tests/test_chaos_parity.py drives it every
                                fuzz round; ChaosOracle holds the scalar
@@ -340,6 +360,41 @@ def unpack_u16_pairs(words: jnp.ndarray, k: int) -> jnp.ndarray:  # gc: uint32[W
     return jnp.stack(planes)
 
 
+def pack_bits_g(plane: jnp.ndarray) -> jnp.ndarray:  # gc: bool[..., G]
+    """Pack a bool plane 32:1 along its LAST (group) axis: bool[..., G] ->
+    uint32[..., ceil(G/32)], word w's bit j holding group 32*w + j.
+
+    This is the scan-carry form of the `recent_active bool[P, P, G]`
+    damping plane (the single largest plane ISSUE 7 added): the donated
+    double-buffered runners (`ClusterSim.run_compiled`, the fused-damped
+    bench loop) carry the packed words between rounds and unpack only at
+    the step boundary, so the per-round carry traffic for the plane drops
+    ~32x.  Packing along G (not the plane axis like `pack_bits`) keeps the
+    word planes group-minor — the packed lanes stay on the TPU's 128-wide
+    vector axis.  Lossless for any G (groups past G pad with zeros);
+    `simref.host_pack_bits_g` is the numpy twin
+    (tests/test_multiraft_kernels.py)."""
+    g = plane.shape[-1]
+    n_words = (g + 31) // 32
+    pad = n_words * 32 - g
+    bits = plane.astype(jnp.uint32)
+    if pad:
+        bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+    bits = bits.reshape(plane.shape[:-1] + (n_words, 32))
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    # Bits are disjoint, so the shifted sum is a bitwise OR; dtype= keeps
+    # the reduction uint32 under x64 (GC007).
+    return jnp.sum(bits << lanes, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits_g(words: jnp.ndarray, g: int) -> jnp.ndarray:  # gc: uint32[..., W]
+    """Inverse of pack_bits_g: uint32[..., ceil(g/32)] -> bool[..., g]."""
+    lanes = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> lanes) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * 32,))
+    return flat[..., :g] != 0
+
+
 # check_safety violation-count vector indices.
 SV_DUAL_LEADER = 0  # two leaders share a term in one group
 SV_COMMIT_DIVERGED = 1  # two peers' committed prefixes disagree
@@ -435,6 +490,67 @@ def check_quorum_active(
         return (cnt >= majority_of(n)) | (n == 0)
 
     return half(voter_mask) & half(outgoing_mask)
+
+
+def cq_boundary_safe(
+    recent_active: jnp.ndarray,  # gc: bool[P, P, G]
+    voter_mask: jnp.ndarray,  # gc: bool[P, G]
+    outgoing_mask: jnp.ndarray,  # gc: bool[P, G]
+    state: jnp.ndarray,  # gc: int32[P, G]
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    election_elapsed: jnp.ndarray,  # gc: int32[P, G]
+    horizon: int,
+    election_tick: int,
+) -> jnp.ndarray:
+    """bool[G]: every check-quorum boundary that CAN fire within `horizon`
+    rounds provably passes — the damping half of the fused steady
+    predicate (pallas_step.steady_mask).
+
+    A boundary (tick_kernel's want_check_quorum at a role-leader's
+    election-timeout) reads-and-clears the leader's recent_active row and
+    steps it down without an active quorum.  On a steady all-links-up
+    horizon that outcome is provable per group when:
+
+      * every ALIVE leader's row holds an active quorum NOW
+        (check_quorum_active) — recent_active only accumulates until the
+        next clear, so the first in-horizon boundary passes;
+      * the alive voters form a quorum of each (possibly joint) half —
+        after any clear, one full heartbeat interval (the caller requires
+        election_tick > heartbeat_tick) re-saturates the row with every
+        alive member's ack before the NEXT boundary, so later boundaries
+        pass too;
+      * no CRASHED role-leader reaches its boundary at all
+        (election_elapsed + horizon < election_tick; a crashed leader's
+        timer runs free and its row receives no acks, so its boundary
+        outcome is its carried row — conservatively excluded).
+
+    Lossy (chaos) horizons cannot prove re-saturation and use the fully
+    conservative no-boundary-at-all bound instead (steady_mask inlines
+    it); this kernel is the lossless branch only.
+    """
+    alive = ~crashed
+    is_lead_alive = (state == ROLE_LEADER) & alive
+    qa = check_quorum_active(recent_active, voter_mask, outgoing_mask)
+    lead_ok = jnp.all(jnp.where(is_lead_alive, qa, True), axis=0)
+
+    def half_alive(mask):
+        # dtype= on the masked counts: GC007 (bare bool sums widen under
+        # x64).
+        cnt = jnp.sum(alive & mask, axis=0, dtype=jnp.int32)  # [G]
+        n = jnp.sum(mask, axis=0, dtype=jnp.int32)
+        return (cnt >= majority_of(n)) | (n == 0)
+
+    alive_quorum = half_alive(voter_mask) & half_alive(outgoing_mask)
+    stale = (state == ROLE_LEADER) & crashed
+    stale_ok = jnp.all(
+        jnp.where(
+            stale,
+            election_elapsed + jnp.int32(horizon) < jnp.int32(election_tick),
+            True,
+        ),
+        axis=0,
+    )
+    return lead_ok & alive_quorum & stale_ok
 
 
 def timeout_draw(

@@ -34,6 +34,20 @@ kernel (_steady_chaos_kernel): link plane healed by predicate, per-link
 loss drawn IN-KERNEL with the (round, src, dst, group) counter PRNG,
 bit-identical to k sequential sim.step(link=) rounds.  The chaos variants
 stream packed sub-int32 operand planes (GC008 PACKED_PLANES registry).
+
+Election damping (ISSUE 8): check_quorum/pre_vote configs — the deployed
+raft-rs production configuration — ride their own fused kernel family
+(_steady_damped_kernel, the same health/counters/chaos composition
+surface), bit-identical to k `sim._damped_linked_step` rounds: on a
+steady horizon damping has closed form — heartbeat acks saturate the
+leader's recent_active row every heartbeat interval so the check-quorum
+boundary provably passes (the kernel advances the boundary's
+read-and-clear cycle in-kernel), leases are never tested and pre-vote is
+dormant (no elections), and the low-term nudge cannot fire (uniform
+terms).  steady_mask widens with the damping conditions
+(kernels.cq_boundary_safe lossless; a conservative free-running bound on
+the cq boundary under loss), so damped fusion needs the same
+`election_tick > k` regime as chaos.
 """
 
 from __future__ import annotations
@@ -225,6 +239,41 @@ def _steady_kernel(
         refs[n_in + 6][...] = tsc
 
 
+def _kernel_loss_draw(round_base, r, gids, lane, loss_rate):
+    """In-kernel seeded per-link loss sample: kernels.link_loss_draw
+    inlined with tile-global group ids (`gids` offset by the program id)
+    and the precomputed (src, dst) `lane` plane — the ONE copy both the
+    chaos and damped fused kernels draw from, so the (round, src, dst,
+    group) PRNG keying cannot drift between them."""
+    round_u = (round_base + jnp.int32(r)).astype(jnp.uint32)  # [1, B]
+    x0 = kernels_mod._mix32(gids * jnp.uint32(0x9E3779B1) + round_u)
+    x = kernels_mod._mix32(
+        x0[None, :, :] ^ (lane * jnp.uint32(0x85EBCA6B))
+    )  # [P, P, B]
+    return (x % jnp.uint32(kernels_mod.LOSS_SCALE)).astype(
+        jnp.int32
+    ) < loss_rate
+
+
+def _agree_event(agree, in_set, value, lead_f):
+    """One wholesale-adoption agreement event (sim._merge_agree with the
+    acting leader as the sender): pairs inside `in_set` agree to `value`;
+    pairs with one side inside inherit the leader's row.  Shared by the
+    chaos and damped fused kernels."""
+    lead_row = jnp.sum(
+        agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
+    )  # [P, B] = agree[leader, :]
+    return jnp.where(
+        in_set[:, None, :] & in_set[None, :, :],
+        value[None, :, :],
+        jnp.where(
+            in_set[:, None, :],
+            lead_row[None, :, :],
+            jnp.where(in_set[None, :, :], lead_row[:, None, :], agree),
+        ),
+    )
+
+
 def _quorum_tile(matched, voter, qpos, P):
     """Majority index of a [P, B] matched tile over its voter rows: the
     same odd-even transposition network as the plain steady kernel (the
@@ -312,33 +361,12 @@ def _steady_chaos_kernel(
         return jnp.sum(plane * lead_f, axis=0, keepdims=True, dtype=jnp.int32)
 
     def agree_event(agree, in_set, value):
-        """One wholesale-adoption agreement event (sim._linked_step's
-        triple-where): pairs inside `in_set` agree to `value`; pairs with
-        one side inside inherit the leader's row."""
-        lead_row = jnp.sum(
-            agree * lead_f[:, None, :], axis=0, dtype=jnp.int32
-        )  # [P, B] = agree[leader, :]
-        return jnp.where(
-            in_set[:, None, :] & in_set[None, :, :],
-            value[None, :, :],
-            jnp.where(
-                in_set[:, None, :],
-                lead_row[None, :, :],
-                jnp.where(in_set[None, :, :], lead_row[:, None, :], agree),
-            ),
-        )
+        # sim._linked_step's triple-where, shared with the damped kernel.
+        return _agree_event(agree, in_set, value, lead_f)
 
     for r in range(rounds):
-        # --- seeded per-link loss draw (kernels.link_loss_draw, inlined
-        # with the tile-global group ids).
-        round_u = (round_base + jnp.int32(r)).astype(jnp.uint32)  # [1, B]
-        x0 = kernels_mod._mix32(gids * jnp.uint32(0x9E3779B1) + round_u)
-        x = kernels_mod._mix32(
-            x0[None, :, :] ^ (lane * jnp.uint32(0x85EBCA6B))
-        )  # [P, P, B]
-        drop = (x % jnp.uint32(kernels_mod.LOSS_SCALE)).astype(
-            jnp.int32
-        ) < loss_rate
+        # --- seeded per-link loss draw (the shared in-kernel PRNG).
+        drop = _kernel_loss_draw(round_base, r, gids, lane, loss_rate)
         # Forward (leader -> v) and reverse (v -> leader) delivery for this
         # round; the link plane itself is all-up among alive peers by the
         # steady predicate, so only the loss sample gates delivery.
@@ -549,7 +577,13 @@ def steady_round(
     in-kernel with the (round, src, dst, group) counter PRNG, bit-identical
     to `rounds` sequential sim.step(link=healed & ~loss_draw) calls.  The
     extras order is always (loss, round_base), counters, health —
-    sim.step's extras convention."""
+    sim.step's extras convention.
+
+    Damping-on configs (SimConfig.check_quorum / pre_vote) build the
+    damped kernel family instead (_steady_damped_kernel) with the same
+    signatures per flag combination, bit-identical to `rounds` sequential
+    damped wave rounds (sim._damped_linked_step) — including the
+    check-quorum boundary's recent_active read-and-clear cycle."""
     P = cfg.n_peers
     G = cfg.n_groups
     block = min(BLOCK, G)
@@ -557,6 +591,15 @@ def steady_round(
 
     pg_spec = pl.BlockSpec((P, block), lambda i: (0, i), memory_space=pltpu.VMEM)
     g_spec = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    if cfg.check_quorum or cfg.pre_vote:
+        # Election-damping configs route to the damped kernel family
+        # (ISSUE 8): same composition surface (health/counters/chaos),
+        # built separately so the undamped graphs stay byte-identical.
+        return _build_damped_round(
+            cfg, rounds, with_health, with_counters, with_chaos, interpret,
+            pg_spec, g_spec, grid, block,
+        )
 
     if with_chaos:
         return _build_chaos_round(
@@ -842,6 +885,472 @@ def _build_chaos_round(
     return fn
 
 
+def _steady_damped_kernel(
+    # inputs: roles_ref (packed state|leader_id|hb), ee, li, lt, commit,
+    # matched_row (acting leader's tracker row), ra (acting leader's
+    # recent_active row, 0/1), masks_ref (packed voter|member|crashed)
+    # [P, B]; agree [P, P, B] [+ loss_rate [P, P, B] when with_loss];
+    # ts, lead_term, app [1, B] [+ round_base when with_loss, tsc when
+    # with_health]; outputs: roles, ee, li, lt, commit, matched_row, ra,
+    # agree [+ tsc].
+    *refs,
+    P: int,
+    block: int,
+    rounds: int,
+    election_tick: int,
+    heartbeat_tick: int,
+    with_health: bool,
+    with_cq: bool,
+    with_loss: bool,
+):
+    """The damping-on steady round: k rounds of sim._damped_linked_step's
+    wave replay specialized to the steady invariant (uniform terms among
+    alive peers, one alive acting leader, all links up among alive peers,
+    no campaign can fire), bit-identically — including the check-quorum
+    read-and-clear `recent_active` cycle at the leader's election-timeout
+    boundary (`with_cq`; the steady predicate proves every in-horizon
+    boundary passes, so the boundary's only effect is the clear), the
+    damped probe rule (first-probe prev from modeled cursors, retry-chain
+    adoption whose acks land one stage later than probe-matched ones), and
+    — `with_loss` — the chaos engine's in-kernel per-link loss draws.
+    Leases and the low-term nudge are provably dormant on a steady horizon
+    (no vote requests, uniform terms), so they need no carry."""
+    n_in = 12 + (2 if with_loss else 0) + (1 if with_health else 0)
+    i = 0
+    (
+        roles_ref, ee_ref, li_ref, lt_ref, commit_ref, matched_ref,
+        ra_ref, masks_ref, agree_ref,
+    ) = refs[:9]
+    i = 9
+    if with_loss:
+        loss_ref = refs[i]
+        i += 1
+    ts_ref, ltm_ref, app_ref = refs[i : i + 3]
+    i += 3
+    if with_loss:
+        rb_ref = refs[i]
+        i += 1
+    if with_health:
+        tsc_ref = refs[i]
+    (
+        roles_out, ee_out, li_out, lt_out, commit_out, matched_out,
+        ra_out, agree_out,
+    ) = refs[n_in : n_in + 8]
+    state, leader_id, hb = _unpack_roles(roles_ref[...])
+    voter, member, crashed = _unpack_masks(masks_ref[...])
+    ee = ee_ref[...]
+    li = li_ref[...]
+    lt = lt_ref[...]
+    commit = commit_ref[...]
+    matched_row = matched_ref[...]
+    ra = ra_ref[...] != 0  # [P, B] the acting leader's recent_active row
+    agree = agree_ref[...]
+    ts = ts_ref[...]  # [1, B] acting leader's term_start_index
+    ltm = ltm_ref[...]  # [1, B] acting leader's term
+    app = app_ref[...]  # [1, B]
+    if with_loss:
+        loss_rate = loss_ref[...]  # [P, P, B]
+        round_base = rb_ref[...]  # [1, B]
+    if with_health:
+        tsc = tsc_ref[...]
+        maxc_prev = jnp.max(commit, axis=0, keepdims=True)
+
+    alive = ~crashed
+    role_leader = state == ROLE_LEADER
+    is_lead = role_leader & alive  # exactly one per group by the predicate
+    has_leader = jnp.any(is_lead, axis=0, keepdims=True)  # [1, B]
+    lead_f = is_lead.astype(jnp.int32)
+    p_iota = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+    # dtype= on every sum: see _steady_kernel (GC007).
+    lead_id_val = jnp.sum(
+        lead_f * (p_iota + 1), axis=0, keepdims=True, dtype=jnp.int32
+    )
+    count = jnp.sum(voter, axis=0, keepdims=True, dtype=jnp.int32)
+    qpos = count // 2
+    n_app = jnp.where(has_leader, app, 0)  # [1, B]
+    sent_b = has_leader & (n_app > 0)
+    if with_loss:
+        gids = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+            + pl.program_id(0) * block
+        ).astype(jnp.uint32)
+        s_io = jax.lax.broadcasted_iota(jnp.uint32, (P, P, 1), 0)
+        d_io = jax.lax.broadcasted_iota(jnp.uint32, (P, P, 1), 1)
+        lane = s_io * jnp.uint32(P) + d_io + jnp.uint32(1)
+
+    def lead_gather(plane):  # [P, B] -> [1, B]: the acting leader's value
+        return jnp.sum(plane * lead_f, axis=0, keepdims=True, dtype=jnp.int32)
+
+    def agree_event(agree, in_set, value):
+        # sim._merge_agree with the acting leader as the sender — the
+        # same shared triple-where as the chaos kernel.
+        return _agree_event(agree, in_set, value, lead_f)
+
+    def agree_lead(agree):  # [P, B]: agree[leader, :] right now
+        return jnp.sum(agree * lead_f[:, None, :], axis=0, dtype=jnp.int32)
+
+    for r in range(rounds):
+        if with_loss:
+            # Seeded per-link loss — the round's single delivery draw,
+            # from the same shared in-kernel PRNG as the chaos kernel.
+            drop = _kernel_loss_draw(round_base, r, gids, lane, loss_rate)
+            dfl = jnp.any(drop & is_lead[:, None, :], axis=0)  # [P, B]
+            dtl = jnp.any(drop & is_lead[None, :, :], axis=1)
+            fwd = ~dfl & alive & ~is_lead
+            rev = ~dtl & alive & ~is_lead
+        else:
+            fwd = alive & ~is_lead
+            rev = fwd
+
+        # --- tick, incl. the leader's election-timeout boundary.  With
+        # check-quorum the boundary READS-AND-CLEARS the leader's
+        # recent_active row; the predicate proves the read passes (and
+        # that no crashed stale leader reaches its boundary), so the
+        # deposition/heartbeat-suppression arms are provably dead.
+        ee = ee + 1
+        boundary = role_leader & (ee >= election_tick)
+        ee = jnp.where(boundary, 0, ee)
+        if with_cq:
+            lead_bnd = jnp.any(
+                boundary & is_lead, axis=0, keepdims=True
+            )  # [1, B]
+            ra = jnp.where(lead_bnd, is_lead, ra)  # clear to the self row
+        hb = jnp.where(role_leader, hb + 1, hb)
+        want_beat = role_leader & (hb >= heartbeat_tick)
+        hb = jnp.where(want_beat, 0, hb)
+        beat = jnp.any(want_beat & is_lead, axis=0, keepdims=True)  # [1, B]
+
+        # Round-start snapshots of the acting leader's cursors.
+        c_l = lead_gather(commit)  # [1, B]
+        li_l = lead_gather(li)
+        lt_l = lead_gather(lt)
+
+        # --- wave 1: heartbeat delivery (terms uniform: every delivered
+        # heartbeat is accepted, no nudges can fire).
+        h_acc = fwd & beat & member
+        state = jnp.where(h_acc, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(h_acc, lead_id_val, leader_id)
+        ee = jnp.where(h_acc, 0, ee)
+        hb_val = jnp.minimum(matched_row, c_l)
+        commit = jnp.where(h_acc, jnp.maximum(commit, hb_val), commit)
+
+        # --- wave 2a: heartbeat responses resume probes and set the
+        # leader's recent_active bits; lagging members trigger catch-up.
+        resumed = h_acc & rev
+        ra = ra | resumed
+        cu = resumed & (matched_row < li_l)
+
+        # --- wave 3: catch-up appends with the DAMPED probe rule: prev
+        # comes from the modeled cursor (never-acked members probe from
+        # the election noop), non-matching probes start a retry chain
+        # whose wholesale adoption lands after stage A and whose ack
+        # folds only at the wave-6 stage (sim._damped_linked_step).
+        agree_l = agree_lead(agree)
+        prev3 = jnp.where(matched_row == 0, ts - 1, li_l)
+        probe3 = agree_l >= prev3
+        adopt3 = cu & probe3
+        retry3 = cu & ~probe3  # cu implies the reverse link is up
+        commit = jnp.where(adopt3, jnp.maximum(commit, c_l), commit)
+        li = jnp.where(adopt3, li_l, li)
+        lt = jnp.where(adopt3, lt_l, lt)
+        agree = agree_event(
+            agree,
+            adopt3 | (is_lead & jnp.any(adopt3, axis=0, keepdims=True)),
+            li_l,
+        )
+        ack3 = adopt3
+
+        # --- wave 4: stage fold over the probe-matched acks + stage-A
+        # quorum commit at the leader.
+        matched_row = jnp.where(
+            ack3, jnp.maximum(matched_row, li_l), matched_row
+        )
+        ra = ra | ack3
+        mci = _quorum_tile(matched_row, voter, qpos, P)
+        ok_a = has_leader & (count > 0) & (mci >= ts)
+        c_new = jnp.where(ok_a, jnp.maximum(c_l, mci), c_l)
+        adv = c_new > c_l
+        commit = jnp.where(is_lead, c_new, commit)
+
+        # --- wave-3 retry resends (the surviving maybe_decr chain): the
+        # resend lands as wholesale adoption AFTER stage A; its ack joins
+        # the wave-6 fold below.
+        commit = jnp.where(retry3, jnp.maximum(commit, c_l), commit)
+        li = jnp.where(retry3, li_l, li)
+        lt = jnp.where(retry3, lt_l, lt)
+        agree = agree_event(
+            agree,
+            retry3 | (is_lead & jnp.any(retry3, axis=0, keepdims=True)),
+            li_l,
+        )
+
+        # --- wave 5: the commit-advance re-broadcast to sendable members
+        # (Replicate probes + freshly resumed ones), damped probe rule.
+        agree_l2 = agree_lead(agree)
+        sendable = (matched_row > 0) | resumed
+        rb5 = fwd & member & adv & sendable
+        prev5 = jnp.where(matched_row == 0, ts - 1, li_l)
+        probe5 = agree_l2 >= prev5
+        adopt5 = rb5 & probe5
+        retry5 = rb5 & ~probe5 & rev
+        state = jnp.where(rb5, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(rb5, lead_id_val, leader_id)
+        ee = jnp.where(rb5, 0, ee)
+        li = jnp.where(adopt5, li_l, li)
+        lt = jnp.where(adopt5, lt_l, lt)
+        agree = agree_event(
+            agree,
+            adopt5 | (is_lead & jnp.any(adopt5, axis=0, keepdims=True)),
+            li_l,
+        )
+        li = jnp.where(retry5, li_l, li)
+        lt = jnp.where(retry5, lt_l, lt)
+        agree = agree_event(
+            agree,
+            retry5 | (is_lead & jnp.any(retry5, axis=0, keepdims=True)),
+            li_l,
+        )
+        ack5 = (adopt5 & rev) | retry3 | retry5
+
+        # --- wave 6: stage fold over the deferred acks + stage-B commit,
+        # then the settled commit propagates to sendable members.
+        matched_row = jnp.where(
+            ack5, jnp.maximum(matched_row, li_l), matched_row
+        )
+        ra = ra | ack5
+        mci2 = _quorum_tile(matched_row, voter, qpos, P)
+        ok_b = has_leader & (count > 0) & (mci2 >= ts)
+        c_new2 = jnp.where(ok_b, jnp.maximum(c_new, mci2), c_new)
+        commit = jnp.where(is_lead, c_new2, commit)
+        agree_l3 = agree_lead(agree)
+        sendable2 = (matched_row > 0) | resumed
+        elig6 = (
+            fwd
+            & member
+            & sendable2
+            & ((agree_l3 >= li_l) | rev)
+            & (c_new2 > c_l)
+        )
+        commit = jnp.where(elig6, jnp.maximum(commit, c_new2), commit)
+        ra = ra | (elig6 & rev)
+
+        # --- the round's append workload at the acting leader (nudge
+        # cutoffs on its ack stream are provably empty: terms uniform).
+        li = li + jnp.where(is_lead, n_app, 0)
+        lt = jnp.where(is_lead & sent_b, ltm, lt)
+        lead_last = li_l + n_app  # [1, B]
+        pr_ok = (matched_row > 0) | resumed
+        send_w = sent_b & fwd & member & pr_ok
+        agree_l4 = agree_lead(agree)
+        probe_w = agree_l4 >= jnp.where(matched_row == 0, ts - 1, li_l)
+        sync_b = send_w & (probe_w | rev)
+        state = jnp.where(send_w, ROLE_FOLLOWER, state)
+        leader_id = jnp.where(send_w, lead_id_val, leader_id)
+        ee = jnp.where(send_w, 0, ee)
+        li = jnp.where(sync_b, lead_last, li)
+        lt = jnp.where(sync_b, ltm, lt)
+        ack_w = sync_b & rev
+        acked = ack_w | (is_lead & sent_b)
+        matched_row = jnp.where(
+            acked, jnp.maximum(matched_row, lead_last), matched_row
+        )
+        ra = ra | ack_w
+        agree = agree_event(agree, sync_b | (is_lead & sent_b), lead_last)
+        mci3 = _quorum_tile(matched_row, voter, qpos, P)
+        ok_c = sent_b & (count > 0) & (mci3 >= ts)
+        lead_commit = jnp.where(ok_c, jnp.maximum(c_new2, mci3), c_new2)
+        commit = jnp.where(is_lead, lead_commit, commit)
+        commit = jnp.where(
+            sync_b, jnp.maximum(commit, lead_commit), commit
+        )
+
+        if with_health:
+            maxc = jnp.max(commit, axis=0, keepdims=True)
+            tsc = jnp.where(maxc > maxc_prev, 0, tsc + 1)
+            maxc_prev = maxc
+
+    roles_out[...] = _pack_roles(state, leader_id, hb)
+    ee_out[...] = ee
+    li_out[...] = li
+    lt_out[...] = lt
+    commit_out[...] = commit
+    matched_out[...] = matched_row
+    ra_out[...] = ra.astype(jnp.int32)
+    agree_out[...] = agree
+    if with_health:
+        refs[n_in + 8][...] = tsc
+
+
+def _build_damped_round(
+    cfg: SimConfig,
+    rounds: int,
+    with_health: bool,
+    with_counters: bool,
+    with_chaos: bool,
+    interpret: bool,
+    pg_spec,
+    g_spec,
+    grid,
+    block: int,
+):
+    """The damping-on fused steady round (check_quorum/pre_vote configs):
+    see steady_round's docstring.  Separate builder — like the chaos one —
+    so the damped machinery cannot perturb the undamped kernels' traced
+    graphs (pinned by jaxpr equality in tests/test_pallas_step.py)."""
+    P = cfg.n_peers
+    G = cfg.n_groups
+    assert P <= 15, "packed roles word budgets 4 bits for leader_id"
+    assert cfg.heartbeat_tick < (1 << 24), (
+        "packed roles word budgets 24 bits for heartbeat_elapsed"
+    )
+    ppg_spec = pl.BlockSpec(
+        (P, P, block), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+    )
+    kernel = functools.partial(
+        _steady_damped_kernel,
+        P=P,
+        block=block,
+        rounds=rounds,
+        election_tick=cfg.election_tick,
+        heartbeat_tick=cfg.heartbeat_tick,
+        with_health=with_health,
+        with_cq=cfg.check_quorum,
+        with_loss=with_chaos,
+    )
+    n_ppg_in = 2 if with_chaos else 1
+    n_g_in = 3 + (1 if with_chaos else 0) + (1 if with_health else 0)
+    out_shape = [jax.ShapeDtypeStruct((P, G), jnp.int32)] * 7 + [
+        jax.ShapeDtypeStruct((P, P, G), jnp.int32)
+    ]
+    out_specs = [pg_spec] * 7 + [ppg_spec]
+    if with_health:
+        out_shape = out_shape + [jax.ShapeDtypeStruct((1, G), jnp.int32)]
+        out_specs = out_specs + [g_spec]
+    interp_kw = {"interpret": True} if interpret else {}
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pg_spec] * 8 + [ppg_spec] * n_ppg_in + [g_spec] * n_g_in,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        **interp_kw,
+    )
+
+    def _run(
+        st: SimState,
+        crashed: jnp.ndarray,
+        append_n: jnp.ndarray,
+        loss_rate: Optional[jnp.ndarray],
+        round_base: Optional[jnp.ndarray],
+        tsc_in: Optional[jnp.ndarray],
+    ):
+        if st.recent_active is None:
+            raise ValueError(
+                "fused damped round needs the recent_active plane but the "
+                "state has None — this state was built for an undamped "
+                "config; rebuild it with init_state(cfg)"
+            )
+        is_leader = (st.state == ROLE_LEADER) & ~crashed
+        f = is_leader.astype(jnp.int32)
+        # dtype= keeps the gathered rows int32 under x64 (GC007).
+        acting_row = jnp.sum(
+            st.matched * f[:, None, :], axis=0, dtype=jnp.int32
+        )  # [P, G]
+        ra_row = jnp.any(
+            st.recent_active & is_leader[:, None, :], axis=0
+        )  # [P, G] bool
+        ts_acting = jnp.sum(
+            st.term_start_index * f, axis=0, dtype=jnp.int32
+        )  # [G]
+        lead_term = jnp.sum(st.term * f, axis=0, dtype=jnp.int32)  # [G]
+        member = st.voter_mask | st.learner_mask
+        # Crashed stale leaders' frozen tracker rows need no carry: the
+        # damped wave path's per-round stage folds are idempotent for an
+        # owner whose row receives no acks, and every state REACHABLE
+        # through that path leaves each stale owner's commit already
+        # settled against its frozen row at the round boundary — so k
+        # fused rounds that leave them untouched are bit-identical to k
+        # general rounds (pinned per configuration in
+        # tests/test_pallas_step.py).
+        inputs = (
+            _pack_roles(st.state, st.leader_id, st.heartbeat_elapsed),
+            st.election_elapsed,
+            st.last_index,
+            st.last_term,
+            st.commit,
+            acting_row,
+            ra_row.astype(jnp.int32),
+            _pack_masks(st.voter_mask, member, crashed),
+            st.agree,
+        )
+        if loss_rate is not None:
+            inputs = inputs + (loss_rate,)
+        inputs = inputs + (
+            ts_acting[None, :],
+            lead_term[None, :],
+            append_n[None, :],
+        )
+        if round_base is not None:
+            rb = jnp.broadcast_to(
+                jnp.reshape(round_base.astype(jnp.int32), (1, 1)), (1, G)
+            )
+            inputs = inputs + (rb,)
+        if tsc_in is not None:
+            inputs = inputs + (tsc_in[None, :],)
+        outs = call(*inputs)
+        roles, ee, li, lt, commit, new_row, ra_new, agree = outs[:8]
+        tsc_out = outs[8][0] if tsc_in is not None else None
+        state, leader_id, hb = _unpack_roles(roles)
+        matched = jnp.where(
+            is_leader[:, None, :], new_row[None, :, :], st.matched
+        )
+        recent_active = jnp.where(
+            is_leader[:, None, :], (ra_new != 0)[None, :, :],
+            st.recent_active,
+        )
+        out = st._replace(
+            state=state,
+            leader_id=leader_id,
+            election_elapsed=ee,
+            heartbeat_elapsed=hb,
+            last_index=li,
+            last_term=lt,
+            matched=matched,
+            commit=commit,
+            agree=agree,
+            recent_active=recent_active,
+        )
+        return out, tsc_out
+
+    # Static extras layout (counters before health, sim.step's order).
+    idx_counters = 0 if with_counters else None
+    idx_health = (1 if with_counters else 0) if with_health else None
+
+    def fn(st, crashed, append_n, *rest):
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — closes over the static builder flag (trace-time constant)
+            loss_rate, round_base = rest[0], rest[1]
+            extras = rest[2:]
+        else:
+            loss_rate = round_base = None
+            extras = rest
+        counters = None if idx_counters is None else extras[idx_counters]
+        health = None if idx_health is None else extras[idx_health]
+        tsc_in = None if health is None else health.planes[HP_SINCE_COMMIT]
+        out, tsc_out = _run(
+            st, crashed, append_n, loss_rate, round_base, tsc_in
+        )
+        res: tuple = (out,)
+        if counters is not None:
+            res = res + (_fold_counters(cfg, rounds, st, out, counters),)
+        if health is not None:
+            res = res + (_steady_health_fold(cfg, rounds, health, tsc_out),)
+        if idx_counters is None and idx_health is None:
+            return out
+        return res
+
+    return fn
+
+
 def steady_mask(
     cfg: SimConfig,
     st: SimState,
@@ -861,23 +1370,35 @@ def steady_mask(
     that lets the heartbeat_tick == 1 fast bound assume ee -> 0 cannot be
     relied on.
 
-    Election damping (SimConfig.check_quorum / pre_vote) is NOT modeled
-    by the fused kernels: a steady round under damping also advances the
-    leader's recent_active row and its boundary read-and-clear, which the
-    kernels do not carry.  Damping-on configs are therefore rejected
-    wholesale (all-False mask), so the fused path can never silently
-    diverge — the dispatchers then always take sim.step's damped wave
-    path."""
-    if cfg.check_quorum or cfg.pre_vote:
+    Election damping (SimConfig.check_quorum / pre_vote) adds its own
+    conditions (ISSUE 8; previously damping-on configs were rejected
+    wholesale).  The election-timer bound is always the conservative
+    free-running form (the same `election_tick > horizon` regime as
+    chaos), so the dormancy of pre-vote and the low-term nudge is
+    provable: nobody campaigns, terms stay uniform.  With check_quorum
+    the leader's election-timeout boundary READS the recent_active row:
+    the lossless branch proves every in-horizon boundary passes
+    (kernels.cq_boundary_safe — the leader's row holds an active quorum
+    NOW, the alive voters re-saturate it each heartbeat interval, and no
+    crashed stale leader reaches its boundary); the lossy (`link=`)
+    branch cannot prove re-saturation and requires that NO role-leader
+    reaches its boundary at all."""
+    damped = cfg.check_quorum or cfg.pre_vote
+    if damped and cfg.election_tick <= cfg.heartbeat_tick:
+        # The check-quorum saturation argument needs one full heartbeat
+        # interval strictly inside each boundary window; degenerate
+        # configs fall back to the general damped wave path.
         return jnp.zeros((cfg.n_groups,), bool)
     alive = ~crashed
     # 1. nobody can campaign within the horizon.  With heartbeat_tick == 1
     # an alive follower under a live leader is re-synced (ee -> 0) every
     # round, so only its FIRST tick uses the current ee; crashed peers'
-    # timers run free for the whole horizon.  For larger heartbeat ticks we
-    # fall back to the fully conservative free-running bound.
+    # timers run free for the whole horizon.  For larger heartbeat ticks —
+    # and under damping, where free-running timers are what proves
+    # pre-vote/nudge dormancy — we fall back to the fully conservative
+    # free-running bound.
     non_leader_voter = (st.state != ROLE_LEADER) & st.voter_mask
-    if cfg.heartbeat_tick == 1 and link is None:
+    if cfg.heartbeat_tick == 1 and link is None and not damped:
         may_fire = non_leader_voter & (
             jnp.where(
                 alive,
@@ -913,6 +1434,44 @@ def steady_mask(
             axis=(0, 1),
         )
         ok = ok & links_ok
+    if damped and cfg.check_quorum:
+        # 6. every check-quorum boundary inside the horizon provably
+        # passes.  Lossless: kernels.cq_boundary_safe (leader row holds
+        # an active quorum now; alive voters re-saturate it every
+        # heartbeat interval; crashed stale leaders never reach their
+        # boundary).  Lossy: a dropped heartbeat breaks the saturation
+        # proof, so no role-leader may reach its boundary at all (the
+        # conservative free-running bound on the cq boundary).
+        if st.recent_active is None:
+            raise ValueError(
+                "steady_mask for a check_quorum config needs the "
+                "recent_active plane but the state has None — this state "
+                "was built for an undamped config; rebuild it with "
+                "init_state(cfg)"
+            )
+        if link is None:
+            ok = ok & kernels_mod.cq_boundary_safe(
+                st.recent_active,
+                st.voter_mask,
+                st.outgoing_mask,
+                st.state,
+                crashed,
+                st.election_elapsed,
+                horizon,
+                cfg.election_tick,
+            )
+        else:
+            role_lead = st.state == ROLE_LEADER
+            no_boundary = jnp.all(
+                jnp.where(
+                    role_lead,
+                    st.election_elapsed + jnp.int32(horizon)
+                    < jnp.int32(cfg.election_tick),
+                    True,
+                ),
+                axis=0,
+            )
+            ok = ok & no_boundary
     return ok
 
 

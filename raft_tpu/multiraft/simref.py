@@ -168,6 +168,33 @@ class ScalarCluster:
         return out
 
 
+def host_pack_bits_g(plane: np.ndarray) -> np.ndarray:
+    """Numpy twin of kernels.pack_bits_g: pack a bool plane 32:1 along its
+    LAST (group) axis into uint32 words (word w's bit j = group 32*w + j,
+    zero-padded past G).  The GC010 oracle for the recent_active
+    scan-carry packing — tests/test_multiraft_kernels.py asserts bit-exact
+    equality with the device kernel at awkward widths."""
+    plane = np.asarray(plane, dtype=bool)
+    g = plane.shape[-1]
+    n_words = (g + 31) // 32
+    pad = n_words * 32 - g
+    bits = plane.astype(np.uint32)
+    if pad:
+        bits = np.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+    bits = bits.reshape(plane.shape[:-1] + (n_words, 32))
+    lanes = np.arange(32, dtype=np.uint32)
+    return (bits << lanes).sum(axis=-1).astype(np.uint32)
+
+
+def host_unpack_bits_g(words: np.ndarray, g: int) -> np.ndarray:
+    """Numpy twin of kernels.unpack_bits_g (inverse of host_pack_bits_g)."""
+    words = np.asarray(words, dtype=np.uint32)
+    lanes = np.arange(32, dtype=np.uint32)
+    bits = (words[..., :, None] >> lanes) & np.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * 32,))
+    return flat[..., :g] != 0
+
+
 class HealthOracle:
     """Scalar-side oracle for the device health planes (sim.HealthState).
 

@@ -4,6 +4,7 @@ to an uninterrupted run (determinism makes exact resume testable)."""
 import os
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from raft_tpu.multiraft import ClusterSim, SimConfig
@@ -55,8 +56,6 @@ def test_checkpoint_damped_plane_round_trip(tmp_path):
     checkpoint missing a REQUIRED plane fails loudly.  State is built
     without stepping (init + direct plane writes) so this stays
     compile-free tier-1."""
-    import pytest
-
     from raft_tpu.multiraft import sim as sim_mod
 
     cfg = SimConfig(n_groups=4, n_peers=3, check_quorum=True, pre_vote=True)
@@ -92,3 +91,72 @@ def test_checkpoint_damped_plane_round_trip(tmp_path):
         np.savez(f, **arrays)
     with pytest.raises(ValueError, match="missing required plane"):
         load_state(broken)
+
+
+def test_pack_ra_carry_round_trip():
+    """The scan-carry pack/unpack helpers alone, compile-light tier-1: a
+    damped state's recent_active plane survives pack_ra_carry ->
+    unpack_ra_carry bit-exactly at a ragged G (33 = one full word + a
+    1-bit tail), and an undamped state passes through untouched (None
+    words — the undamped scan graph must stay bit-identical).  The full
+    donated-scan integration is the slow case below; CI's bench-cq step
+    drives the same packed carry end-to-end every run."""
+    from raft_tpu.multiraft import sim as sim_mod
+
+    cfg = SimConfig(n_groups=33, n_peers=3, check_quorum=True, pre_vote=True)
+    st = sim_mod.init_state(cfg)
+    st = st._replace(
+        recent_active=st.recent_active.at[0, 1, ::5].set(True)
+        .at[2, 0, 32].set(True)
+    )
+    stripped, words = sim_mod.pack_ra_carry(st)
+    assert stripped.recent_active is None
+    assert words.shape == (3, 3, 2) and words.dtype == jnp.uint32
+    back = sim_mod.unpack_ra_carry(stripped, words)
+    np.testing.assert_array_equal(
+        np.asarray(back.recent_active), np.asarray(st.recent_active)
+    )
+
+    plain = sim_mod.init_state(SimConfig(n_groups=4, n_peers=3))
+    same, none_words = sim_mod.pack_ra_carry(plain)
+    assert none_words is None and same is plain
+    assert sim_mod.unpack_ra_carry(same, None) is same
+
+
+@pytest.mark.slow  # two damped compiles (~20s at G=33): >5s at G>=32
+def test_run_compiled_damped_packed_carry_and_checkpoint(tmp_path):
+    """ISSUE 8: the donated double-buffered scan (ClusterSim.run_compiled)
+    carries the optional recent_active plane bit-packed 32:1 along G
+    (sim.pack_ra_carry) — it must round-trip the plane bit-exactly against
+    the run_round loop, and a checkpoint saved mid-run (packed plane
+    unpacked back to the bool[P, P, G] format) must resume bit-identically
+    into a further run_compiled scan."""
+    cfg = SimConfig(n_groups=33, n_peers=3, check_quorum=True, pre_vote=True)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+
+    # Reference: the per-round loop (same jitted damped step throughout).
+    a = ClusterSim(cfg)
+    for _ in range(24):
+        a.run_round(None, append)
+
+    # Donated packed-carry scan, interrupted by a checkpoint at round 12.
+    # Both halves are 12 rounds on the SAME sim (the scan runner caches
+    # per instance and rounds count), so the scan compiles ONCE — compile
+    # time is tier-1 budget; the loaded state is all the continuation
+    # carries.
+    b = ClusterSim(cfg)
+    b.run_compiled(12, append_n=append)
+    path = os.path.join(tmp_path, "damped-mid.npz")
+    save_state(b.state, path)
+
+    b.state = load_state(path)
+    assert b.state.recent_active is not None
+    assert np.asarray(b.state.recent_active).dtype == np.bool_
+    b.run_compiled(12, append_n=append)
+
+    for f in a.state._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, f)),
+            np.asarray(getattr(b.state, f)),
+            err_msg=f"field {f}",
+        )

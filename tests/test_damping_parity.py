@@ -17,9 +17,13 @@ Claims pinned here:
      check_quorum is on — the disturbed groups' term growth and
      term_bumps_in_window stay under a pinned ceiling, with zero safety
      violations;
-  4. the fused steady path conservatively rejects damping-on configs
-     (pallas_step.steady_mask all-False), so it can never silently
-     diverge from the damped general step;
+  4. the fused steady path accepts damping-on configs ONLY under the
+     ISSUE 8 damping conditions (pallas_step.steady_mask: free-running
+     timer bound + provable check-quorum boundaries via
+     kernels.cq_boundary_safe) — boot states and damped states that
+     cannot prove the boundary outcome are still rejected, so the fused
+     path can never silently diverge (the fused-damped parity matrix
+     itself lives in tests/test_pallas_step.py);
   5. sim.read_index is link-aware: acks need BOTH directions of the
      leader<->member link, parity-tested against the scalar cluster's
      real MsgReadIndex pump under per-edge drops.
@@ -160,7 +164,15 @@ def test_damping_off_graph_identical():
         sim_mod.step(dcfg, st, crashed, app)
 
 
-def test_steady_mask_rejects_damped_configs():
+def test_steady_mask_damped_gate():
+    """Since ISSUE 8 damping-on configs CAN ride the fused path, but only
+    under the damping conditions: a boot state (no leaders, empty
+    recent_active rows) is still rejected for every flag combination, and
+    a degenerate heartbeat_tick >= election_tick config is rejected
+    wholesale (the boundary re-saturation argument needs a full heartbeat
+    interval inside each boundary window).  The acceptance side — settled
+    damped states fusing bit-identically — is pinned in
+    tests/test_pallas_step.py."""
     for flags in (
         dict(check_quorum=True),
         dict(pre_vote=True),
@@ -174,6 +186,14 @@ def test_steady_mask_rejects_damped_configs():
         assert not bool(
             pallas_step.steady_predicate(cfg, st, crashed)
         ), flags
+    degen = SimConfig(
+        n_groups=4, n_peers=3, check_quorum=True,
+        election_tick=2, heartbeat_tick=2,
+    )
+    st = sim_mod.init_state(degen)
+    assert not np.asarray(
+        pallas_step.steady_mask(degen, st, jnp.zeros((3, 4), bool))
+    ).any()
 
 
 def test_check_quorum_active_kernel():

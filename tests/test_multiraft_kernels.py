@@ -340,6 +340,98 @@ def test_pack_bits_roundtrip_and_numpy_twin():
         assert np.array_equal(np.asarray(back), planes)
 
 
+def test_pack_bits_g_roundtrip_and_simref_twin():
+    """pack_bits_g/unpack_bits_g (the recent_active scan-carry packing,
+    32:1 along the GROUP axis): exact round-trip at widths spanning word
+    boundaries, bit-identical to the simref numpy twins — the GC010
+    oracle for the `bits_g` PACKED_PLANES family."""
+    from raft_tpu.multiraft import simref
+
+    rng = np.random.RandomState(13)
+    for shape in ((3, 3, 5), (2, 31), (2, 32), (2, 33), (1, 64), (4, 95)):
+        plane = rng.rand(*shape) < 0.4
+        words = kernels.pack_bits_g(jnp.asarray(plane))
+        g = shape[-1]
+        assert words.shape == shape[:-1] + ((g + 31) // 32,)
+        assert words.dtype == jnp.uint32
+        twin = simref.host_pack_bits_g(plane)
+        assert np.array_equal(np.asarray(words), twin), shape
+        back = kernels.unpack_bits_g(words, g)
+        assert back.dtype == jnp.bool_
+        assert np.array_equal(np.asarray(back), plane), shape
+        assert np.array_equal(
+            simref.host_unpack_bits_g(twin, g), plane
+        ), shape
+
+
+def test_cq_boundary_safe_conditions():
+    """cq_boundary_safe (the damping half of the fused steady predicate)
+    against its scalar reasoning: leader-row active quorum now, alive
+    voters a quorum of each half, and crashed stale leaders clear of
+    their free-running boundary."""
+    G, P = 4, 3
+    ra = np.zeros((P, P, G), bool)
+    vm = np.ones((P, G), bool)
+    om = np.zeros((P, G), bool)
+    state = np.zeros((P, G), np.int64)
+    state[0, :] = kernels.ROLE_LEADER
+    crashed = np.zeros((P, G), bool)
+    ee = np.zeros((P, G), np.int64)
+
+    def safe(**over):
+        args = dict(ra=ra, vm=vm, om=om, state=state, crashed=crashed,
+                    ee=ee)
+        args.update(over)
+        return np.asarray(
+            kernels.cq_boundary_safe(
+                jnp.asarray(args["ra"]), jnp.asarray(args["vm"]),
+                jnp.asarray(args["om"]),
+                jnp.asarray(args["state"], dtype=jnp.int32),
+                jnp.asarray(args["crashed"]),
+                jnp.asarray(args["ee"], dtype=jnp.int32),
+                horizon=4, election_tick=10,
+            )
+        )
+
+    # empty leader row: only self active -> 1 of 3 < quorum -> unsafe
+    assert not safe().any()
+    # one ack -> 2 of 3 >= quorum for the leader -> safe everywhere
+    ra2 = ra.copy()
+    ra2[0, 1, :] = True
+    assert safe(ra=ra2).all()
+    # alive voters below quorum (two crashed followers): the row may be
+    # saturated NOW but cannot re-saturate after the next clear
+    cr2 = crashed.copy()
+    cr2[1:, 0] = True
+    ra3 = ra2.copy()
+    ra3[0, 2, :] = True
+    got = safe(ra=ra3, crashed=cr2)
+    assert not got[0] and got[1:].all()
+    # a crashed stale role-leader near its boundary poisons its group
+    st2 = state.copy()
+    cr3 = crashed.copy()
+    st2[2, 1] = kernels.ROLE_LEADER
+    cr3[2, 1] = True
+    ee2 = ee.copy()
+    ee2[2, 1] = 7  # 7 + horizon(4) >= election_tick(10)
+    got = safe(ra=ra2, state=st2, crashed=cr3, ee=ee2)
+    assert not got[1] and got[[0, 2, 3]].all()
+    # ...but a stale leader far from its boundary is fine
+    ee2[2, 1] = 3
+    assert safe(ra=ra2, state=st2, crashed=cr3, ee=ee2).all()
+    # joint config: BOTH halves need an alive quorum
+    vm2 = np.zeros((P, G), bool)
+    vm2[:2] = True
+    om2 = np.zeros((P, G), bool)
+    om2[1:] = True
+    ra4 = np.zeros((P, P, G), bool)
+    ra4[0, 1, :] = True  # incoming {1,2} active; outgoing {2,3} not
+    got = safe(ra=ra4, vm=vm2, om=om2)
+    assert not got.any()
+    ra4[0, 2, :] = True
+    assert safe(ra=ra4, vm=vm2, om=om2).all()
+
+
 def test_pack_u16_pairs_roundtrip_and_numpy_twin():
     rng = np.random.RandomState(12)
     for k in (1, 2, 5, 25):

@@ -560,6 +560,368 @@ def test_plain_jaxpr_unchanged_by_new_flags():
     assert str(base) == str(flagged)
 
 
+# --- fused election damping (ISSUE 8) ---------------------------------------
+#
+# The damped kernel family (_steady_damped_kernel) must be bit-identical —
+# per-round state AND health planes AND the recent_active plane — to k
+# general damped wave rounds (sim._damped_linked_step) per configuration:
+# plain / health / counters / chaos, each under cq and cq+pv.  Tier-1 keeps
+# one small case per flag mode sharing the module-scoped settles below; the
+# rest of the matrix is slow (the 870s gate is saturated — ROADMAP.md).
+
+DK = 4  # fused horizon for the damped cases
+
+
+def _snapshot(st):
+    """Host copy of a SimState (donation-safe restore point)."""
+    return tuple(
+        None if v is None else np.asarray(v) for v in st
+    )
+
+
+def _restore(snap):
+    return sim.SimState(
+        *(None if v is None else jnp.asarray(v) for v in snap)
+    )
+
+
+@pytest.fixture(scope="module")
+def cq_settled():
+    """One check-quorum ClusterSim + settled-state snapshot: every cq case
+    (tier-1 and slow) shares this sim's damped-wave compile."""
+    cfg = SimConfig(n_groups=8, n_peers=3, check_quorum=True)
+    s = ClusterSim(cfg)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    s.run(30, None, append)
+    return s, _snapshot(s.state)
+
+
+@pytest.fixture(scope="module")
+def cq_pv_settled():
+    """The fully damped configuration (cq + pre-vote) with health planes."""
+    cfg = SimConfig(
+        n_groups=8, n_peers=3, check_quorum=True, pre_vote=True,
+        collect_health=True, health_window=8,
+    )
+    s = ClusterSim(cfg)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    s.run(30, None, append)
+    return s, _snapshot(s.state)
+
+
+def _assert_state_equal(want, got, note):
+    for f in want._fields:
+        va, vb = getattr(want, f), getattr(got, f)
+        np.testing.assert_array_equal(
+            np.asarray(va), np.asarray(vb), err_msg=f"{note} field {f}"
+        )
+
+
+def _general_blocks(s, st0, crashed, append, blocks, k):
+    """Drive `blocks` k-round blocks through the module sim's own jitted
+    damped step (no extra compile); returns the per-block states."""
+    s.state = st0
+    out = []
+    for _ in range(blocks):
+        for _ in range(k):
+            s.run_round(crashed, append)
+        out.append(_snapshot(s.state))
+    return [_restore(x) for x in out]
+
+
+def test_damped_fused_parity_cq_plain(cq_settled):
+    """plain × cq: 5 fused blocks from a settled state — the horizon
+    crosses the leader's election-timeout boundary (election_tick=10,
+    20 rounds), so the in-kernel recent_active read-and-clear cycle is
+    exercised, not just ack accumulation."""
+    s, snap = cq_settled
+    cfg = s.cfg
+    st = _restore(snap)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    fused = jax.jit(pallas_step.steady_round(cfg, rounds=DK))
+    want = _general_blocks(s, _restore(snap), crashed, append, 5, DK)
+    got = st
+    for blk in range(5):
+        assert bool(
+            pallas_step.steady_predicate(cfg, got, crashed, horizon=DK)
+        ), f"block {blk}"
+        got = fused(got, crashed, append)
+        _assert_state_equal(want[blk], got, f"cq-plain block {blk}")
+
+
+def test_damped_fused_parity_cq_pv_health(cq_pv_settled):
+    """health × cq+pv with a crashed follower per group: the fused health
+    fold (in-kernel ticks_since_commit + closed-form window math, with a
+    window boundary inside the horizon) and the recent_active plane must
+    both match the general damped rounds exactly."""
+    s, snap = cq_pv_settled
+    cfg = s.cfg
+    st = _restore(snap)
+    crashed_np = np.zeros((cfg.n_peers, cfg.n_groups), bool)
+    leaders = np.asarray(st.state).argmax(axis=0)
+    for g in range(cfg.n_groups):
+        crashed_np[(leaders[g] + 1) % cfg.n_peers, g] = True
+    crashed = jnp.asarray(crashed_np)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    assert bool(
+        pallas_step.steady_predicate(cfg, st, crashed, horizon=DK)
+    )
+    def make_h0():  # fresh arrays: the module sim's step DONATES health
+        return sim.init_health(cfg)._replace(
+            planes=sim.init_health(cfg).planes.at[2].set(3).at[3].set(5),
+            window_pos=jnp.int32(7),  # boundary inside the horizon
+        )
+
+    # General side reuses the module sim's health-threaded compile.
+    s.state = _restore(snap)
+    s._health = make_h0()
+    for _ in range(DK):
+        s.run_round(crashed, append)
+    want_st, want_h = s.state, s._health
+    fused = jax.jit(
+        pallas_step.steady_round(cfg, rounds=DK, with_health=True)
+    )
+    got_st, got_h = fused(st, crashed, append, make_h0())
+    _assert_state_equal(want_st, got_st, "cq+pv-health")
+    np.testing.assert_array_equal(
+        np.asarray(want_h.planes), np.asarray(got_h.planes)
+    )
+    assert int(want_h.window_pos) == int(got_h.window_pos)
+
+
+def test_damped_steady_mask_rejection_conditions(cq_settled):
+    """The damping-specific rejection arms (docs/PERF.md): a boot state
+    (no leaders), a leader whose recent_active row lacks an active quorum
+    (fresh become_leader, no acks yet), a crashed stale leader near its
+    cq boundary, and — on the lossy branch — ANY role-leader near its
+    boundary."""
+    s, snap = cq_settled
+    cfg = s.cfg
+    st = _restore(snap)
+    G, P = cfg.n_groups, cfg.n_peers
+    crashed = jnp.zeros((P, G), bool)
+    # boot: nobody elected
+    assert not np.asarray(
+        pallas_step.steady_mask(cfg, sim.init_state(cfg), crashed)
+    ).any()
+    # a leader with a cleared recent_active row (as become_leader leaves
+    # it) must be rejected until acks re-saturate it
+    bare = st._replace(
+        recent_active=jnp.zeros((P, P, G), bool)
+    )
+    assert not np.asarray(
+        pallas_step.steady_mask(cfg, bare, crashed)
+    ).any()
+    # crashed stale leader whose free-running timer reaches the boundary
+    # inside the horizon: group 0 rejected, others still steady
+    leaders = np.asarray(st.state).argmax(axis=0)
+    stale_np = np.zeros((P, G), bool)
+    stale_np[(leaders[0] + 1) % P, 0] = True
+    st_np = np.asarray(st.state).copy()
+    ee_np = np.asarray(st.election_elapsed).copy()
+    st_np[(leaders[0] + 1) % P, 0] = 2  # ROLE_LEADER
+    ee_np[(leaders[0] + 1) % P, 0] = cfg.election_tick - 1
+    staled = st._replace(
+        state=jnp.asarray(st_np), election_elapsed=jnp.asarray(ee_np)
+    )
+    mask = np.asarray(
+        pallas_step.steady_mask(
+            cfg, staled, jnp.asarray(stale_np), horizon=DK
+        )
+    )
+    assert not mask[0] and mask[1:].all()
+    # lossy branch: the ACTING leader near its boundary rejects too (the
+    # lossless branch accepts it via the qa proof).  Every leader's timer
+    # is first moved clear of the boundary, then group 0's right onto it.
+    link = jnp.ones((P, P, G), bool)
+    ee2 = np.asarray(st.election_elapsed).copy()
+    ee2[leaders, np.arange(G)] = 2
+    ee2[leaders[0], 0] = cfg.election_tick - 1
+    near = st._replace(election_elapsed=jnp.asarray(ee2))
+    m_lossy = np.asarray(
+        pallas_step.steady_mask(cfg, near, crashed, horizon=DK, link=link)
+    )
+    m_lossless = np.asarray(
+        pallas_step.steady_mask(cfg, near, crashed, horizon=DK)
+    )
+    assert not m_lossy[0] and m_lossy[1:].all()
+    assert m_lossless[0]
+
+
+def test_damped_build_leaves_undamped_graphs_unchanged():
+    """The damped kernel family must not perturb the undamped traces: a
+    config with the damping flags explicitly False builds byte-identical
+    steady_round / fast_multi_round jaxprs (the ISSUE 8 extension of the
+    flags-off pin)."""
+    cfg = SimConfig(n_groups=4, n_peers=3)
+    cfg_explicit = SimConfig(
+        n_groups=4, n_peers=3, check_quorum=False, pre_vote=False
+    )
+    st = sim.init_state(cfg)
+    crashed = jnp.zeros((3, 4), bool)
+    append = jnp.zeros((4,), jnp.int32)
+    for build in (
+        lambda c: pallas_step.steady_round(c, rounds=2),
+        lambda c: pallas_step.fast_multi_round(c, k=2),
+    ):
+        base = jax.make_jaxpr(build(cfg))(st, crashed, append)
+        explicit = jax.make_jaxpr(build(cfg_explicit))(st, crashed, append)
+        assert str(base) == str(explicit)
+
+
+@pytest.mark.slow  # the remaining flag-mode cross product (two compiles)
+def test_damped_fused_parity_matrix_plain_health(cq_settled, cq_pv_settled):
+    """health × cq and plain × cq+pv — the other half of the
+    plain/health matrix, off the shared settles."""
+    # health × cq (health extra threads through a cfg without
+    # collect_health — with_health is a build flag, like sim.step's kw)
+    s, snap = cq_settled
+    cfg = s.cfg
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    h0 = sim.init_health(cfg)._replace(window_pos=jnp.int32(3))
+    step_h = jax.jit(
+        lambda s_, h: sim.step(cfg, s_, crashed, append, health=h)
+    )
+    want_st, want_h = _restore(snap), h0
+    for _ in range(DK):
+        want_st, want_h = step_h(want_st, want_h)
+    fused = jax.jit(
+        pallas_step.steady_round(cfg, rounds=DK, with_health=True)
+    )
+    got_st, got_h = fused(_restore(snap), crashed, append, h0)
+    _assert_state_equal(want_st, got_st, "health-cq")
+    np.testing.assert_array_equal(
+        np.asarray(want_h.planes), np.asarray(got_h.planes)
+    )
+    # plain × cq+pv off the cq+pv settle
+    s2, snap2 = cq_pv_settled
+    cfg2 = s2.cfg
+    fused2 = jax.jit(pallas_step.steady_round(cfg2, rounds=DK))
+    step2 = jax.jit(lambda s_: sim.step(cfg2, s_, crashed, append))
+    want = _restore(snap2)
+    for _ in range(DK):
+        want = step2(want)
+    got = fused2(_restore(snap2), crashed, append)
+    _assert_state_equal(want, got, "plain-cq+pv")
+
+
+@pytest.mark.slow  # its own pv-only settle + two fresh damped compiles
+def test_damped_fused_parity_pv_only():
+    """plain × pre-vote-only: SimConfig(pre_vote=True) alone routes to
+    _steady_damped_kernel(with_cq=False) in production (steady_mask's
+    damped arm skips the cq-specific conditions), so the never-cleared
+    recent_active accumulation arm needs its own parity pin — the cq
+    cases above always cross a read-and-clear boundary."""
+    cfg = SimConfig(n_groups=8, n_peers=3, pre_vote=True)
+    s = ClusterSim(cfg)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    s.run(30, None, append)
+    snap = _snapshot(s.state)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    fused = jax.jit(pallas_step.steady_round(cfg, rounds=DK))
+    want = _general_blocks(s, _restore(snap), crashed, append, 5, DK)
+    got = _restore(snap)
+    for blk in range(5):
+        assert bool(
+            pallas_step.steady_predicate(cfg, got, crashed, horizon=DK)
+        ), f"block {blk}"
+        got = fused(got, crashed, append)
+        _assert_state_equal(want[blk], got, f"pv-only block {blk}")
+
+
+@pytest.mark.slow  # two counter-threaded damped compiles
+def test_damped_fused_counters_closed_form(cq_settled, cq_pv_settled):
+    """counters × cq and counters × cq+pv: the closed-form CTR_* fold
+    (campaigns/wins provably 0, heartbeat fires arithmetic — incl. any
+    crashed role-leader's free-running timer, commit deltas telescoping)
+    == threading the plane through k damped wave rounds."""
+    from raft_tpu.multiraft import kernels
+
+    for fixture in (cq_settled, cq_pv_settled):
+        s, snap = fixture
+        cfg = s.cfg
+        crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+        append = jnp.ones((cfg.n_groups,), jnp.int32)
+        step_c = jax.jit(
+            lambda s_, c, cfg=cfg, crashed=crashed: sim.step(
+                cfg, s_, crashed, append, counters=c
+            )
+        )
+        want_st, want_c = _restore(snap), kernels.zero_counters()
+        for _ in range(DK):
+            want_st, want_c = step_c(want_st, want_c)
+        fused = jax.jit(
+            pallas_step.steady_round(cfg, rounds=DK, with_counters=True)
+        )
+        got_st, got_c = fused(
+            _restore(snap), crashed, append, kernels.zero_counters()
+        )
+        note = f"counters cq={cfg.check_quorum} pv={cfg.pre_vote}"
+        np.testing.assert_array_equal(
+            np.asarray(want_c), np.asarray(got_c), err_msg=note
+        )
+        _assert_state_equal(want_st, got_st, note)
+
+
+@pytest.mark.slow  # chaos-on damped compiles at election_tick=60
+def test_damped_fused_chaos_both_branches():
+    """chaos × cq and chaos(+health) × cq+pv through the dispatcher: 18
+    k=4 blocks cross the election_tick=60 boundary window, so the
+    conservative free-running cq-boundary bound rejects some blocks —
+    BOTH lax.cond branches run and every block stays bit-identical
+    (state, health planes, recent_active) to k general
+    sim.step(link & ~loss_draw) rounds."""
+    for flags in (
+        dict(check_quorum=True),
+        dict(check_quorum=True, pre_vote=True, collect_health=True,
+             health_window=8),
+    ):
+        cfg = _chaos_cfg(**flags)
+        has_h = cfg.collect_health
+        G, P = cfg.n_groups, cfg.n_peers
+        st = settle(cfg, rounds=150)
+        crashed = jnp.zeros((P, G), bool)
+        append = jnp.ones((G,), jnp.int32)
+        link = jnp.ones((P, P, G), bool)
+        loss = _loss_plane(G, P)
+        k = DK
+        fast = jax.jit(
+            pallas_step.fast_multi_round(
+                cfg, k=k, with_chaos=True, with_health=has_h
+            )
+        )
+        general = _make_general_linked(cfg, crashed, append, has_h=has_h)
+        h0 = sim.init_health(cfg) if has_h else None
+        a, b, ha, hb = st, st, h0, h0
+        rb = 150
+        n_fused = n_gen = 0
+        blocks = 18 if has_h else 8
+        for blk in range(blocks):
+            pred = bool(
+                pallas_step.steady_predicate(cfg, b, crashed, k, link)
+            )
+            n_fused += pred
+            n_gen += not pred
+            a, _, ha = general(a, link, loss, rb, k, health=ha)
+            if has_h:
+                b, hb = fast(b, crashed, append, link, loss,
+                             jnp.int32(rb), hb)
+                np.testing.assert_array_equal(
+                    np.asarray(ha.planes), np.asarray(hb.planes)
+                )
+            else:
+                b = fast(b, crashed, append, link, loss, jnp.int32(rb))
+            _assert_state_equal(a, b, f"chaos {flags} block {blk}")
+            rb += k
+        assert n_fused > 0, flags
+        if has_h:
+            # the long run crosses the boundary window: the general
+            # branch must have been taken at least once too
+            assert n_gen > 0, flags
+
+
 @pytest.mark.slow  # compiles the full cond(fused, scan-of-general) graph
 def test_fast_multi_round_health_both_branches():
     """fast_multi_round(with_health=True): the fused branch (steady start)

@@ -22,7 +22,15 @@ configuration exits non-zero, and so does a damped run whose term growth
 fails to undercut the undamped run on the asymmetric-link scenario — the
 churn collapse this PR exists to demonstrate.
 
-Usage:  python tools/chaos_churn_report.py [--groups N] [--out FILE]
+With `--fused` (the CI setting since ISSUE 8) each scenario's damped
+half ALSO replays through the fused damped dispatcher
+(pallas_step.fast_multi_round's lax.cond — fused steady rounds and
+general chaos rounds both covered) and the run exits non-zero if any
+churn stat diverges from the scan-damped run, pinning that fusion
+cannot change churn results.
+
+Usage:  python tools/chaos_churn_report.py [--groups N] [--fused]
+        [--out FILE]
 """
 
 from __future__ import annotations
@@ -35,6 +43,10 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# (groups, n_peers) -> (SimConfig, jitted fused dispatcher, jitted general
+# step), shared across the corpus so each graph compiles once.
+_FUSED_CACHE: dict = {}
 
 
 def run_config(doc: dict, groups: int, damped: bool) -> dict:
@@ -63,9 +75,102 @@ def run_config(doc: dict, groups: int, damped: bool) -> dict:
     }
 
 
+def run_config_fused(doc: dict, groups: int) -> dict:
+    """Replay the damped configuration through the FUSED damped
+    dispatcher (ISSUE 8): fully-healed rounds go through
+    pallas_step.fast_multi_round(k=1)'s lax.cond — fused when the damped
+    steady predicate holds, the general damped wave otherwise, so BOTH
+    branches get golden-corpus coverage — and chaos rounds run the same
+    link-gated general step the compiled scan uses.  The caller diffs the
+    churn stats against the scan-damped run to pin that fusion cannot
+    change churn results."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.multiraft import SimConfig, chaos, kernels, pallas_step
+    from raft_tpu.multiraft import sim as sim_mod
+
+    plan = chaos.plan_from_dict(doc)
+    # One compile per (groups, n_peers) across the whole corpus: a fresh
+    # fast_multi_round closure per scenario would re-trace and re-compile
+    # the identical both-branches damped cond graph six times over.
+    key = (groups, plan.n_peers)
+    if key not in _FUSED_CACHE:
+        cfg = SimConfig(
+            n_groups=groups,
+            n_peers=plan.n_peers,
+            collect_health=True,
+            check_quorum=True,
+            pre_vote=True,
+        )
+        interpret = jax.default_backend() == "cpu"
+        _FUSED_CACHE[key] = (
+            cfg,
+            jax.jit(
+                pallas_step.fast_multi_round(
+                    cfg, k=1, with_health=True, interpret=interpret
+                )
+            ),
+            jax.jit(functools.partial(sim_mod.step, cfg)),
+        )
+    cfg, fast, general = _FUSED_CACHE[key]
+    sched = chaos.HostSchedule(plan, groups)
+    st = sim_mod.init_state(cfg)
+    h = sim_mod.init_health(cfg)
+    safety = np.zeros(kernels.N_SAFETY, np.int64)
+    prev_commit = np.asarray(st.commit)
+    n_fused = n_dispatched = 0
+    for r in range(plan.n_rounds):
+        link, crashed, append = sched.masks(r)
+        cj = jnp.asarray(crashed)
+        aj = jnp.asarray(append, dtype=jnp.int32)
+        if bool(link.all()):
+            # Fully-healed round: bit-identical to link=None, so it can
+            # ride the (lossless-branch) fused dispatcher.
+            n_dispatched += 1
+            n_fused += bool(
+                pallas_step.steady_predicate(cfg, st, cj, horizon=1)
+            )
+            st, h = fast(st, cj, aj, h)
+        else:
+            st, h = general(st, cj, aj, link=jnp.asarray(link), health=h)
+        safety += np.asarray(
+            kernels.check_safety(
+                st.state, st.term, st.commit, st.last_index, st.agree,
+                jnp.asarray(prev_commit),
+            )
+        )
+        prev_commit = np.asarray(st.commit)
+    planes = np.asarray(h.planes)
+    term = np.asarray(st.term)
+    return {
+        "max_term": int(term.max()),
+        "peak_term_bumps": int(planes[kernels.HP_TERM_BUMPS].max()),
+        "vote_splits": int(planes[kernels.HP_VOTE_SPLITS].max()),
+        "fused_rounds": n_fused,
+        "dispatched_rounds": n_dispatched,
+        "rounds": plan.n_rounds,
+        "safety": dict(
+            zip(kernels.SAFETY_NAMES, (int(v) for v in safety))
+        ),
+    }
+
+
+FUSED_COMPARE_KEYS = ("max_term", "peak_term_bumps", "vote_splits")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--groups", type=int, default=128)
+    ap.add_argument(
+        "--fused",
+        action="store_true",
+        help="also run each scenario's damped half through the fused "
+        "damped dispatcher (pallas_step.fast_multi_round) and fail if "
+        "any churn stat diverges from the scan-damped run",
+    )
     ap.add_argument("--out", default="chaos-churn-report.json")
     ap.add_argument(
         "--plans",
@@ -79,6 +184,7 @@ def main() -> int:
         docs = json.load(f)
     out = {"groups": args.groups, "plans": {}}
     failed = []
+    total_fused = 0
     for doc in docs:
         name = doc["name"]
         undamped = run_config(doc, args.groups, damped=False)
@@ -93,13 +199,31 @@ def main() -> int:
             "damped": damped,
             "term_growth_ratio": round(ratio, 3) if ratio is not None else None,
         }
-        for tag, rep in (("undamped", undamped), ("damped", damped)):
+        checked = (("undamped", undamped), ("damped", damped))
+        if args.fused:
+            fused = run_config_fused(doc, args.groups)
+            out["plans"][name]["damped_fused"] = fused
+            checked = checked + (("damped_fused", fused),)
+            total_fused += fused["fused_rounds"]
+            for key in FUSED_COMPARE_KEYS:
+                if fused[key] != damped[key]:
+                    failed.append(
+                        f"{name}: fused-damped {key} {fused[key]} != "
+                        f"scan-damped {damped[key]} — fusion changed the "
+                        "churn result"
+                    )
+        for tag, rep in checked:
             if any(rep["safety"].values()):
                 failed.append(f"{name}/{tag}: safety {rep['safety']}")
         print(
             f"{name}: max_term {undamped['max_term']} -> "
             f"{damped['max_term']}, peak bumps "
             f"{undamped['peak_term_bumps']} -> {damped['peak_term_bumps']}"
+        )
+    if args.fused and total_fused == 0:
+        failed.append(
+            "no golden-corpus round engaged the fused damped branch; the "
+            "both-branches coverage claim is vacuous (predicate rot?)"
         )
     # The headline claim: damping collapses the asymmetric-partition term
     # inflation (the PR 5 pinned pathology).  The scenario MUST be in the

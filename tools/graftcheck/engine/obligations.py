@@ -135,6 +135,10 @@ class _Resolver:
             return self._resolve_class_first(parts)
         mod_file = self._module_file(pkg, parts[0])
         if mod_file is None:
+            # Device-package modules (simref.host_pack_bits_g, chaos.
+            # host_loss_draw) live one level down in raft_tpu/multiraft.
+            mod_file = self._module_file(pkg / "multiraft", parts[0])
+        if mod_file is None:
             return None
         if len(parts) == 1:
             return (self._rel(mod_file), 1, [])

@@ -80,6 +80,11 @@ PACKED_PLANES: Dict[str, tuple] = {
     # chaos._rate_to_fp validates into [0, LOSS_SCALE] with
     # LOSS_SCALE == 10_000 < 2**16.
     "u16_pairs": (16, "loss rates <= LOSS_SCALE (chaos._rate_to_fp)"),
+    # kernels.pack_bits_g/unpack_bits_g lanes: bools packed 32:1 along the
+    # GROUP axis (word w's bit j = group 32*w + j) — the recent_active
+    # scan-carry form (ISSUE 8); 1 bit by construction, zero-padded past
+    # G, exact round-trip vs the simref.host_pack_bits_g numpy twin.
+    "bits_g": (1, "bool planes packed along G; lossless by construction"),
     # pallas_step's packed chaos-kernel operands (not kernels.py fns; the
     # builders assert the bounds at construction time):
     #   roles word = state | leader_id << 2 | heartbeat_elapsed << 6
