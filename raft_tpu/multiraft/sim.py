@@ -2740,7 +2740,16 @@ class ClusterSim:
         state (and counter/health extras) double-buffer in place instead of
         paying a fresh allocation + host dispatch per round, the same shape
         the chaos runner uses (chaos.make_runner).  Cached per (rounds,
-        link-threading)."""
+        link-threading).
+
+        "Donated" here is verified, not assumed: XLA can silently decline
+        a donation it cannot alias, so the GC011 trace audit checks every
+        donated buffer of the run_compiled@* inventory rows — including
+        the packed recent_active carry — against the compiled alias map
+        (tools/graftcheck/trace/inventory.py); a declined donation fails
+        `make lint`.  The constant per-scan planes (crashed, append_n,
+        link) are deliberately NOT donated: callers reuse them across scan
+        segments."""
         key = (rounds, has_link)
         runner = self._scan_runners.get(key)
         if runner is not None:

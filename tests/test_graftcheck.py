@@ -1072,3 +1072,522 @@ def test_rule_filter_on_engine_rule_requires_engine(tmp_path, monkeypatch, capsy
     rc = gm.main(["--rule", "GC008", "raft_tpu"])
     assert rc == 2
     assert "--engine" in capsys.readouterr().err
+
+
+# --- PR 9 trace rules (GC011-GC014): analysis of the LOWERED artifacts ----
+# Fixture graphs are TINY jitted fns (one or two eqns, sub-second CPU
+# compiles) driven through the same trace_inventory() driver as the real
+# inventory; the full flag-matrix run lives in `make lint` and the
+# graftcheck-trace CI job, not in tier-1 (it is ~60s of XLA compiles).
+
+
+def _trace_spec(name, build, const_budget=256):
+    from tools.graftcheck.trace.inventory import GraphSpec
+
+    return GraphSpec(
+        name=name,
+        anchor="raft_tpu/multiraft/sim.py",
+        build=build,
+        const_budget=const_budget,
+    )
+
+
+def _trace_run(specs):
+    from tools.graftcheck.trace.analysis import trace_inventory
+
+    return trace_inventory(specs)
+
+
+def _declined_build():
+    # A donated input whose shape matches NO output: XLA cannot alias it
+    # and silently declines the donation — exactly GC011's quarry.
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import Built
+
+    fn = jax.jit(lambda x: x.sum(), donate_argnums=(0,))
+    return Built(fn, (jnp.zeros((8, 8), jnp.int32),), (0,))
+
+
+def test_gc011_declined_donation_flags():
+    vs, measured = _trace_run([_trace_spec("declined@fixture", _declined_build)])
+    assert ids(vs) == ["GC011"]
+    assert "alias map" in vs[0].message and "[0][0]" in vs[0].message
+    # The measurement side still records the graph for GC014.
+    assert measured["declined@fixture"] >= 1
+
+
+def test_gc011_accepted_donation_passes():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        fn = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        return Built(fn, (jnp.zeros((8, 8), jnp.int32),), (0,))
+
+    vs, _ = _trace_run([_trace_spec("accepted@fixture", build)])
+    assert vs == []
+
+
+def test_gc011_registry_drift_flags():
+    # The inventory declares donate=(0,) but the production wrapper jits
+    # WITHOUT donation: the registry and the lowering disagree.
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        return Built(
+            jax.jit(lambda x: x + 1), (jnp.zeros((8,), jnp.int32),), (0,)
+        )
+
+    vs, _ = _trace_run([_trace_spec("drift@fixture", build)])
+    assert ids(vs) == ["GC011"]
+    assert "disagree" in vs[0].message
+
+
+def test_gc011_allow_registry_accepts_decline(monkeypatch):
+    from tools.graftcheck.trace import analysis
+
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW,
+        ("declined@fixture", "[0][0]"),
+        "fixture: reduction output cannot alias its input",
+    )
+    vs, _ = _trace_run([_trace_spec("declined@fixture", _declined_build)])
+    assert vs == []
+
+
+def test_gc011_stale_allow_entry_flags(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace import analysis
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        fn = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        return Built(fn, (jnp.zeros((8,), jnp.int32),), (0,))
+
+    # XLA ACCEPTS this donation, so an allow entry for it is rot.
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW,
+        ("stale@fixture", "[0][0]"),
+        "obsolete justification",
+    )
+    vs, _ = _trace_run([_trace_spec("stale@fixture", build)])
+    assert ids(vs) == ["GC011"]
+    assert "matches no declined" in vs[0].message
+
+
+def test_gc011_allow_entry_without_reason_flags(monkeypatch):
+    from tools.graftcheck.trace import analysis
+
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW, ("declined@fixture", "[0][0]"), "  "
+    )
+    vs, _ = _trace_run([_trace_spec("declined@fixture", _declined_build)])
+    # An unjustified entry suppresses nothing (the decline still fires)
+    # AND is itself a violation — the GC000 discipline.
+    assert ids(vs) == ["GC011", "GC011"]
+    assert any("no justification" in v.message for v in vs)
+
+
+def test_gc011_allow_entry_for_unknown_graph_flags(monkeypatch):
+    # A typo'd (or removed-graph) entry matches nothing traced; it would
+    # suppress nothing and rot forever if the stale check skipped it.
+    from tools.graftcheck.trace import analysis
+
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW,
+        ("declinedX@fixture", "[0][0]"),
+        "typo'd graph name",
+    )
+    vs, _ = _trace_run([_trace_spec("declined@fixture", _declined_build)])
+    assert ids(vs) == ["GC011", "GC011"]
+    assert any("names no inventoried graph" in v.message for v in vs)
+
+
+def test_gc011_allow_entry_for_non_donating_graph_flags(monkeypatch):
+    # The named graph exists but declares no donations, so the entry can
+    # never match a decline — rot of a different flavor.
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace import analysis
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        return Built(jax.jit(lambda x: x + 1), (jnp.zeros((8,), jnp.int32),))
+
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW,
+        ("nodonate@fixture", "[0][0]"),
+        "graph stopped donating",
+    )
+    vs, _ = _trace_run([_trace_spec("nodonate@fixture", build)])
+    assert ids(vs) == ["GC011"]
+    assert "matches no declined" in vs[0].message
+
+
+def test_gc011_allow_entry_for_unaudited_graph_flags(monkeypatch):
+    # audit_donation=False rows run no donation audit at all, so an allow
+    # entry pointed at one can never match.
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace import analysis
+    from tools.graftcheck.trace.inventory import Built, GraphSpec
+
+    def build():
+        return Built(jax.jit(lambda x: x + 1), (jnp.zeros((8,), jnp.int32),))
+
+    spec = GraphSpec(
+        name="unaudited@fixture",
+        anchor="raft_tpu/multiraft/sim.py",
+        build=build,
+        audit_donation=False,
+    )
+    monkeypatch.setitem(
+        analysis.DONATION_ALLOW,
+        ("unaudited@fixture", "[0][0]"),
+        "points at an unaudited row",
+    )
+    vs, _ = _trace_run([spec])
+    assert ids(vs) == ["GC011"]
+    assert "audit_donation=False" in vs[0].message
+
+
+def test_gc011_reverse_drift_flags():
+    # The wrapper DONATES but the registry row declares none: the drift
+    # check must be bidirectional, or a donation added without updating
+    # the inventory is invisible (and its decline unauditable).
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        fn = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        return Built(fn, (jnp.zeros((8,), jnp.int32),))
+
+    vs, _ = _trace_run([_trace_spec("reverse-drift@fixture", build)])
+    assert ids(vs) == ["GC011"]
+    assert "disagree" in vs[0].message
+
+
+def test_gc012_oversized_closure_const_flags():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        big = jnp.arange(512, dtype=jnp.int32)  # 2048B > any sane budget
+        return Built(
+            jax.jit(lambda x: x + big), (jnp.zeros((512,), jnp.int32),)
+        )
+
+    vs, _ = _trace_run([_trace_spec("const@fixture", build)])
+    assert ids(vs) == ["GC012"]
+    assert "2048-byte const" in vs[0].message
+    # The same graph under a budget that admits the const passes: the
+    # threshold, not the existence of consts, is the rule.
+    vs, _ = _trace_run(
+        [_trace_spec("const@fixture", build, const_budget=4096)]
+    )
+    assert vs == []
+
+
+def test_gc012_catches_small_g_plane_at_default_budget():
+    # The audit shape is tiny (G=8, P=3), so a closed-over bool[P, P, G]
+    # is only 72B there — the DEFAULT budget must still catch it, or the
+    # rule misses its stated quarry at exactly the shape it audits.
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftcheck.trace.inventory import (
+        Built,
+        DEFAULT_CONST_BYTES,
+    )
+
+    def build():
+        plane = jnp.ones((3, 3, 8), bool)  # the smallest per-group plane
+        return Built(
+            jax.jit(lambda x: x & plane), (jnp.zeros((3, 3, 8), bool),)
+        )
+
+    assert DEFAULT_CONST_BYTES < 72
+    vs, _ = _trace_run(
+        [
+            _trace_spec(
+                "plane@fixture", build, const_budget=DEFAULT_CONST_BYTES
+            )
+        ]
+    )
+    assert ids(vs) == ["GC012"]
+    assert "72-byte const" in vs[0].message
+
+
+def test_gc013_io_callback_in_graph_flags():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    from tools.graftcheck.trace.inventory import Built
+
+    def build():
+        def fn(x):
+            io_callback(lambda v: None, None, x)
+            jax.debug.print("s={s}", s=x.sum())
+            return x + 1
+
+        return Built(jax.jit(fn), (jnp.zeros((8,), jnp.int32),))
+
+    vs, _ = _trace_run([_trace_spec("callback@fixture", build)])
+    assert ids(vs) == ["GC013", "GC013"]
+    prims = " ".join(v.message for v in vs)
+    assert "io_callback" in prims and "debug_callback" in prims
+
+
+def test_trace_build_failure_is_a_finding():
+    def build():
+        raise ValueError("fixture build exploded")
+
+    vs, measured = _trace_run([_trace_spec("broken@fixture", build)])
+    assert ids(vs) == ["GC000"]
+    assert "failed to build/trace" in vs[0].message
+    assert measured == {}
+
+
+# --- GC014 jaxpr-budget (stdlib: the committed file + the check logic) ---
+
+
+def _committed_budget():
+    from pathlib import Path
+
+    from tools.graftcheck.trace.budget import budget_path, load_budget
+
+    repo = Path(__file__).resolve().parents[1]
+    return load_budget(budget_path(repo))
+
+
+def test_gc014_committed_budget_parses_and_replays_green():
+    from tools.graftcheck.trace.budget import check_budget
+
+    doc = _committed_budget()
+    assert doc is not None and doc["graphs"], (
+        "committed jaxpr_budget.json must parse (regenerate with "
+        "`make jaxpr-budget`)"
+    )
+    measured = {n: e["eqns"] for n, e in doc["graphs"].items()}
+    vs, diff = check_budget(measured, doc, "tools/graftcheck/jaxpr_budget.json")
+    assert vs == []
+    assert all(g["status"] == "ok" for g in diff["graphs"].values())
+
+
+def test_gc014_budget_regression_replay_fails():
+    # The bench-gate negative test, for jaxprs: replay the committed
+    # budget with ONE measurement inflated past tolerance — the gate
+    # must fail, or it gates nothing.
+    from tools.graftcheck.trace.budget import check_budget
+
+    doc = _committed_budget()
+    measured = {n: e["eqns"] for n, e in doc["graphs"].items()}
+    name = sorted(measured)[0]
+    tolerance = doc["tolerance_pct"] / 100.0
+    measured[name] = int(measured[name] * (1 + tolerance)) + 2
+    vs, diff = check_budget(measured, doc, "tools/graftcheck/jaxpr_budget.json")
+    assert ids(vs) == ["GC014"] and name in vs[0].message
+    assert diff["graphs"][name]["status"] == "over"
+
+
+def test_gc014_missing_entry_and_stale_entry_flag():
+    from tools.graftcheck.trace.budget import check_budget
+
+    doc = {
+        "format": 1,
+        "tolerance_pct": 15.0,
+        "graphs": {"gone@flags": {"eqns": 10}},
+    }
+    vs, diff = check_budget({"new@flags": 7}, doc, "b.json")
+    assert ids(vs) == ["GC014", "GC014"]
+    msgs = " ".join(v.message for v in vs)
+    assert "no budget entry" in msgs and "stale" in msgs
+    assert diff["graphs"]["new@flags"]["status"] == "new"
+    assert diff["graphs"]["gone@flags"]["status"] == "stale"
+
+
+def test_gc014_missing_budget_file_is_a_violation(tmp_path):
+    from tools.graftcheck.trace.budget import budget_path, check_budget, load_budget
+
+    doc = load_budget(budget_path(tmp_path))  # no file there
+    assert doc is None
+    vs, _ = check_budget({"g@f": 5}, doc, "b.json")
+    assert ids(vs) == ["GC014"]
+    assert "missing or unreadable" in vs[0].message
+
+
+def test_gc014_shrink_never_fails_but_shows_in_diff():
+    from tools.graftcheck.trace.budget import check_budget
+
+    doc = {"format": 1, "tolerance_pct": 15.0, "graphs": {"g@f": {"eqns": 100}}}
+    vs, diff = check_budget({"g@f": 40}, doc, "b.json")
+    assert vs == []
+    assert diff["graphs"]["g@f"]["status"] == "shrunk"
+
+
+def test_gc014_version_mismatch_recorded_and_noted():
+    # The graftcheck-trace CI job installs unpinned jax, so an upstream
+    # lowering change can blow a budget with zero repo changes; the gate
+    # still fails (growth is growth) but the verdict must say where to
+    # look: mismatch in the diff artifact + a note on the violation.
+    from tools.graftcheck.trace.budget import check_budget
+
+    doc = {
+        "format": 1,
+        "tolerance_pct": 15.0,
+        "versions": {"jax": "0.1.0", "jaxlib": "0.1.0"},
+        "graphs": {"g@f": {"eqns": 100}},
+    }
+    newer = {"jax": "9.9.9", "jaxlib": "9.9.9"}
+    vs, diff = check_budget({"g@f": 100}, doc, "b.json", measured_versions=newer)
+    assert vs == [] and diff["version_mismatch"] is True
+    vs, diff = check_budget({"g@f": 200}, doc, "b.json", measured_versions=newer)
+    assert len(vs) == 1 and "upstream jax lowering change" in vs[0].message
+    # Matching versions: no mismatch, no note.
+    same = {"jax": "0.1.0", "jaxlib": "0.1.0"}
+    vs, diff = check_budget({"g@f": 200}, doc, "b.json", measured_versions=same)
+    assert diff["version_mismatch"] is False
+    assert "upstream" not in vs[0].message
+
+
+def test_trace_rules_listed_and_markers_validate(tmp_path):
+    vs = run_on(
+        tmp_path,
+        "raft_tpu/scalar.py",
+        f"{MARK}GC013 — trace rule marker is legal\n",
+    )
+    assert vs == []
+    from tools.graftcheck import all_rules as _all
+
+    ids_ = {r.id for r in _all()}
+    assert {"GC011", "GC012", "GC013", "GC014"} <= ids_
+
+
+# --- the --trace CLI: run cache + jax-version keying ---------------------
+
+
+def test_trace_cache_replays_and_keys_on_jax_version(tmp_path, monkeypatch, capsys):
+    import tools.graftcheck.__main__ as gm
+    import tools.graftcheck.trace as trace_pkg
+    from tools.graftcheck import Violation
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    calls = []
+
+    def fake_run_trace(ctx, update_budget=False, diff_out=None):
+        calls.append(1)
+        return [
+            Violation(
+                "raft_tpu/multiraft/sim.py", 1, "GC013",
+                "host-sync-in-graph", "fixture finding",
+            )
+        ]
+
+    monkeypatch.setattr(trace_pkg, "run_trace", fake_run_trace)
+    rc1 = gm.main(["--trace", "raft_tpu"])
+    out1 = capsys.readouterr().out
+    assert rc1 == 1 and "GC013" in out1 and len(calls) == 1
+    # Unchanged tree + same jax: the cached trace result replays without
+    # re-tracing (the 60s full-inventory run must not re-run per commit).
+    rc2 = gm.main(["--trace", "raft_tpu"])
+    out2 = capsys.readouterr().out
+    assert rc2 == 1 and out2 == out1 and len(calls) == 1
+    # A jax upgrade changes every jaxpr WITHOUT touching one repo file:
+    # the version key must miss the cache (the v2 invalidation gap).
+    monkeypatch.setattr(
+        gm, "_trace_versions", lambda: "jax=99.0.0,jaxlib=99.0.0"
+    )
+    rc3 = gm.main(["--trace", "raft_tpu"])
+    capsys.readouterr()
+    assert rc3 == 1 and len(calls) == 2
+    # And a raft_tpu source change misses it too (mtime fingerprint).
+    monkeypatch.setattr(gm, "_trace_versions", lambda: "jax=1,jaxlib=1")
+    gm.main(["--trace", "raft_tpu"])
+    assert len(calls) == 3
+    f.write_text("x = 2\n")
+    gm.main(["--trace", "raft_tpu"])
+    assert len(calls) == 4
+
+
+def test_update_budget_bypasses_trace_cache(tmp_path, monkeypatch):
+    # --update-budget must ACTUALLY trace (regen is a side effect a
+    # cache replay would skip), even on an unchanged tree.
+    import tools.graftcheck.__main__ as gm
+    import tools.graftcheck.trace as trace_pkg
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    calls = []
+
+    def fake_run_trace(ctx, update_budget=False, diff_out=None):
+        calls.append(update_budget)
+        return []
+
+    monkeypatch.setattr(trace_pkg, "run_trace", fake_run_trace)
+    assert gm.main(["--trace", "raft_tpu"]) == 0
+    assert gm.main(["--update-budget", "raft_tpu"]) == 0
+    assert gm.main(["--update-budget", "raft_tpu"]) == 0
+    assert calls == [False, True, True]
+
+
+def test_rule_filter_on_trace_rule_requires_trace(tmp_path, monkeypatch, capsys):
+    import tools.graftcheck.__main__ as gm
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+    # `--rule GC014` without --trace would exit 0 having run NOTHING
+    # (trace rules never apply per-file) — the same silent-green hazard
+    # as the engine rules.
+    rc = gm.main(["--rule", "GC014", "raft_tpu"])
+    assert rc == 2
+    assert "--trace" in capsys.readouterr().err
+
+
+def test_rule_filter_keeps_trace_build_errors(tmp_path, monkeypatch, capsys):
+    # A graph that fails to BUILD yields only a GC000 trace-build-error;
+    # `--trace --rule GC011` must not filter it out (the broken row found
+    # nothing for GC011, so dropping the build error reads as green).
+    import tools.graftcheck.__main__ as gm
+    import tools.graftcheck.trace as trace_pkg
+    from tools.graftcheck import Violation
+
+    f = tmp_path / "raft_tpu" / "multiraft" / "mod.py"
+    f.parent.mkdir(parents=True)
+    f.write_text("x = 1\n")
+    monkeypatch.chdir(tmp_path)
+
+    def fake_run_trace(ctx, update_budget=False, diff_out=None):
+        return [
+            Violation(
+                "raft_tpu/multiraft/sim.py", 1, "GC000",
+                "trace-build-error", "graph 'x' failed to build/trace",
+            )
+        ]
+
+    monkeypatch.setattr(trace_pkg, "run_trace", fake_run_trace)
+    rc = gm.main(["--trace", "--rule", "GC011", "raft_tpu"])
+    assert rc == 1
+    assert "trace-build-error" in capsys.readouterr().out

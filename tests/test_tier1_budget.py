@@ -1,0 +1,97 @@
+"""tools/tier1_budget.py: the tier-1 gate-saturation report.
+
+Synthetic pytest logs only — the tool is a log scraper, so the fixtures
+are the contract: the ROADMAP.md tier-1 command's tee'd output (summary
+line + optional ``slowest durations`` block) must parse, an over-ceiling
+estimate must exit 1, and an unparseable log must exit 2 (never a silent
+green)."""
+
+import json
+
+from tools.tier1_budget import main, parse_log, top_tests
+
+SUMMARY_OK = "=========== 482 passed, 30 deselected in 690.12s (0:11:30) ===========\n"
+# pytest -q (the ROADMAP tier-1 command) prints the summary WITHOUT bars.
+SUMMARY_OK_QUIET = "506 passed, 25 deselected in 690.37s (0:11:30)\n"
+SUMMARY_OVER = "================== 500 passed in 851.40s (0:14:11) ==================\n"
+
+DURATIONS = """\
+============================= slowest durations =============================
+22.10s call     tests/test_pallas_step.py::test_fused_damped_cq_plain
+19.55s setup    tests/test_damping_parity.py::test_claim4
+7.01s call     tests/test_sharding.py::test_sharded_step
+0.42s call     tests/test_quorum.py::test_majority
+0.30s teardown tests/test_pallas_step.py::test_fused_damped_cq_plain
+"""
+
+
+def test_parse_summary_and_durations():
+    wall, per_test = parse_log(DURATIONS + SUMMARY_OK)
+    assert wall == 690.12
+    # setup+call+teardown sum per nodeid.
+    key = "tests/test_pallas_step.py::test_fused_damped_cq_plain"
+    assert per_test[key] == 22.10 + 0.30
+    ranked = top_tests(per_test, 2)
+    assert [n for n, _ in ranked] == [
+        key,
+        "tests/test_damping_parity.py::test_claim4",
+    ]
+
+
+def test_parse_quiet_summary_form():
+    # -q drops the ``===`` bars; the summary must still beat the
+    # durations-sum undercount as the estimate basis.
+    wall, per_test = parse_log(DURATIONS + SUMMARY_OK_QUIET)
+    assert wall == 690.37
+    assert per_test  # durations still parsed alongside
+    wall_failed, _ = parse_log("1 failed, 505 passed in 702.50s\n")
+    assert wall_failed == 702.50
+
+
+def test_last_summary_line_wins():
+    two = (
+        "==== 3 passed in 1.00s ====\n"
+        + DURATIONS
+        + "==== 482 passed in 690.12s ====\n"
+    )
+    wall, _ = parse_log(two)
+    assert wall == 690.12
+
+
+def test_under_ceiling_passes(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text(DURATIONS + SUMMARY_OK)
+    assert main([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "690.1s" in out and "test_fused_damped_cq_plain" in out
+
+
+def test_over_ceiling_fails_and_reports_json(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text(DURATIONS + SUMMARY_OVER)
+    report = tmp_path / "report.json"
+    assert main([str(log), "--json", str(report)]) == 1
+    assert "OVER" in capsys.readouterr().err
+    doc = json.loads(report.read_text())
+    assert doc["over_ceiling"] is True and doc["estimate_s"] == 851.4
+    assert doc["top"][0]["nodeid"].endswith("test_fused_damped_cq_plain")
+
+
+def test_durations_sum_fallback_without_summary(tmp_path, capsys):
+    # No summary line (e.g. the timeout killed pytest mid-report): the
+    # durations sum is the estimate, labeled as an undercount.
+    log = tmp_path / "t1.log"
+    log.write_text(DURATIONS)
+    assert main([str(log)]) == 0
+    assert "undercount" in capsys.readouterr().out
+    # ... and an over-ceiling durations sum still fails.
+    log.write_text("900.00s call     tests/test_x.py::test_slow\n")
+    assert main([str(log)]) == 1
+
+
+def test_unparseable_log_exits_2(tmp_path, capsys):
+    log = tmp_path / "t1.log"
+    log.write_text("no pytest output here\n")
+    assert main([str(log)]) == 2
+    assert "not a tier-1 log" in capsys.readouterr().err
+    assert main([str(tmp_path / "missing.log")]) == 2

@@ -4,6 +4,14 @@ Flags beyond the basics (docs/STATIC_ANALYSIS.md):
 
   --engine             also run the cross-module abstract-interpretation
                        rules GC007-GC010 (make lint / CI pass this)
+  --trace              also run the trace-level rules GC011-GC014 over the
+                       lowered graph inventory (imports jax; make lint /
+                       the graftcheck-trace CI job pass this)
+  --update-budget      regenerate tools/graftcheck/jaxpr_budget.json from
+                       the measured eqn counts (implies --trace;
+                       `make jaxpr-budget`)
+  --budget-diff-out P  write the GC014 budget-diff artifact JSON to P
+                       (implies --trace; CI uploads it)
   --changed-only       scan only files changed vs --diff-base (default:
                        merge-base with origin/main, falling back to main,
                        then HEAD); the CI lint job uses this on PR diffs
@@ -97,6 +105,65 @@ def _git_changed_files(
     return out, full_scan
 
 
+def _trace_versions() -> str:
+    """jax/jaxlib version key for the --trace run cache: a jax upgrade
+    changes every traced jaxpr without touching one repo file, so trace
+    results keyed on source mtimes alone would replay stale (the v2
+    cache-invalidation gap).  importlib.metadata, not an import — the
+    cache key must be computable without paying the jax import."""
+    from importlib import metadata
+
+    parts = []
+    for pkg in ("jax", "jaxlib"):
+        try:
+            parts.append(f"{pkg}={metadata.version(pkg)}")
+        except metadata.PackageNotFoundError:
+            parts.append(f"{pkg}=absent")
+    return ",".join(parts)
+
+
+def _run_trace_cached(args, ctx: "Context", repo_root: Path) -> Optional[List[Violation]]:
+    """Run (or cache-replay) the GC011-GC014 trace layer; None = hard
+    failure already reported (missing jax)."""
+    from . import trace as trace_pkg
+
+    # Artifact-producing runs (budget regen, diff emission) must actually
+    # trace — a cache replay would skip the side effects.
+    use_cache = (
+        not args.no_cache
+        and not args.update_budget
+        and not args.budget_diff_out
+    )
+    options_key = "trace|" + _trace_versions()
+    files_fp = (
+        cache_mod.fingerprint(["raft_tpu"], repo_root, None)
+        if use_cache
+        else {}
+    )
+    if use_cache:
+        cached = cache_mod.load(repo_root, options_key, files_fp)
+        if cached is not None:
+            return cached
+    try:
+        import jax  # noqa: F401  (availability probe, not a use)
+    except Exception as e:
+        print(
+            f"graftcheck: --trace requires jax (import failed: {e}); the "
+            "trace rules prove properties of the LOWERED graphs and cannot "
+            "run without it",
+            file=sys.stderr,
+        )
+        return None
+    violations = trace_pkg.run_trace(
+        ctx,
+        update_budget=args.update_budget,
+        diff_out=args.budget_diff_out,
+    )
+    if use_cache:
+        cache_mod.store(repo_root, options_key, files_fp, violations)
+    return violations
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftcheck",
@@ -113,6 +180,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--engine",
         action="store_true",
         help="also run the cross-module engine rules GC007-GC010",
+    )
+    ap.add_argument(
+        "--trace",
+        action="store_true",
+        help="also run the trace-level rules GC011-GC014 over the lowered "
+        "graph inventory (imports jax)",
+    )
+    ap.add_argument(
+        "--update-budget",
+        action="store_true",
+        help="regenerate the committed GC014 jaxpr budget from the measured "
+        "eqn counts (implies --trace)",
+    )
+    ap.add_argument(
+        "--budget-diff-out",
+        default=None,
+        metavar="PATH",
+        help="write the GC014 budget-diff artifact JSON to PATH "
+        "(implies --trace)",
     )
     ap.add_argument(
         "--changed-only",
@@ -154,6 +240,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-rules", action="store_true", help="print the rule table"
     )
     args = ap.parse_args(argv)
+    if args.update_budget or args.budget_diff_out:
+        args.trace = True
 
     rules = all_rules()
     if args.list_rules:
@@ -186,6 +274,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(
                 f"{'/'.join(sorted(engine_selected))} are engine rules; "
                 "add --engine to run them",
+                file=sys.stderr,
+            )
+            return 2
+        from .trace.rules import trace_rules
+
+        trace_selected = {
+            r.id
+            for r in trace_rules()
+            if r.id.lower() in wanted or r.slug.lower() in wanted
+        }
+        if trace_selected and not args.trace:
+            # Same silent-green hazard as the engine rules: trace rules
+            # never apply per-file, they run over the lowered inventory.
+            print(
+                f"{'/'.join(sorted(trace_selected))} are trace rules; "
+                "add --trace to run them",
                 file=sys.stderr,
             )
             return 2
@@ -240,12 +344,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     for p in collect_files(scan_paths)
                     if p.resolve() in changed
                 ]
-                if not kept:
+                if not kept and not args.trace:
                     print(
                         "graftcheck: no scanned files changed",
                         file=sys.stderr,
                     )
                     return 0
+                # With --trace the run continues on an empty per-file set:
+                # the trace layer keys on raft_tpu + jax versions, not the
+                # scanned files, and its own cache replays an unchanged
+                # inventory in ~0.3s — an early return here would silently
+                # skip GC011-GC014 in the pre-commit hook.
                 scan_paths = kept
 
     # The cache fingerprints repo files only; a reference checkout (GC005
@@ -297,6 +406,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         if use_cache:
             cache_mod.store(repo_root, options_key, files_fp, violations)
+    if args.trace:
+        trace_violations = _run_trace_cached(args, ctx, repo_root)
+        if trace_violations is None:
+            return 2
+        if wanted is not None:
+            # GC000 trace-build-errors survive any --rule filter: a graph
+            # that failed to build produced NO findings for the selected
+            # rule, so dropping the build error would read as green — the
+            # exact silent-green hazard the exit-2 guard above exists for.
+            trace_violations = [
+                v
+                for v in trace_violations
+                if v.rule_id.lower() in wanted
+                or v.slug.lower() in wanted
+                or v.rule_id == "GC000"
+            ]
+        violations = sorted(
+            violations + trace_violations,
+            key=lambda v: (v.path, v.line, v.rule_id),
+        )
     for v in violations:
         print(v.render())
     if violations:

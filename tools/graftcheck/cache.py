@@ -61,6 +61,9 @@ def fingerprint(
         for p in sorted(pkg.rglob("*.py")):
             add(p)
     add(repo_root / "tools" / "graftcheck" / "parity_obligations.json")
+    # The GC014 budget file changes trace-run results without any source
+    # mtime moving (a regenerated budget must invalidate a cached --trace).
+    add(repo_root / "tools" / "graftcheck" / "jaxpr_budget.json")
     return files
 
 

@@ -1,9 +1,11 @@
 """Rule registry: one module per GC rule, assembled in id order.
 
 The engine rules (GC007-GC010) are cross-module and execute through
-``tools.graftcheck.engine.run_engine`` (the ``--engine`` flag), but they
-live in this registry too so ``--list-rules`` shows them and their
-``allow-GC00x`` markers validate like any other rule's.
+``tools.graftcheck.engine.run_engine`` (the ``--engine`` flag), and the
+trace rules (GC011-GC014) run over the lowered graph inventory through
+``tools.graftcheck.trace.run_trace`` (the ``--trace`` flag), but both
+families live in this registry too so ``--list-rules`` shows them and
+their ``allow-GC0xx`` markers validate like any other rule's.
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ from .gc006_parity_map import KernelParityMap
 
 def all_rules() -> List[Rule]:
     from ..engine.rules import engine_rules
+    from ..trace.rules import trace_rules
 
     return [
         NoImplicitDtype(),
@@ -29,4 +32,4 @@ def all_rules() -> List[Rule]:
         MetricsGuarded(),
         CitationCheck(),
         KernelParityMap(),
-    ] + engine_rules()
+    ] + engine_rules() + trace_rules()

@@ -1,0 +1,34 @@
+"""graftcheck trace layer: GC011-GC014 over the LOWERED artifacts.
+
+The v1/v2 layers prove properties of the source; this package proves
+properties of what XLA actually compiles — the traced jaxprs and the
+executables' alias maps — over the canonical graph inventory
+(``trace/inventory.py``).  The split keeps jax out of the default import
+path: ``rules.py`` (descriptors) and ``budget.py`` (GC014 check/diff
+logic) are stdlib-only so ``--list-rules``, allow-marker validation, and
+the budget unit tests run in jax-less environments; only ``run_trace``
+— the ``--trace`` CLI entry — imports ``analysis.py`` and with it jax.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .budget import (  # noqa: F401  (re-exported for tests/CLI)
+    BUDGET_NAME,
+    DEFAULT_TOLERANCE_PCT,
+    budget_path,
+    check_budget,
+    load_budget,
+    render_budget,
+)
+from .rules import trace_rules  # noqa: F401
+
+
+def run_trace(ctx, update_budget: bool = False, diff_out=None, specs=None) -> List:
+    """Lazy facade over trace.analysis.run_trace (imports jax)."""
+    from . import analysis
+
+    return analysis.run_trace(
+        ctx, update_budget=update_budget, diff_out=diff_out, specs=specs
+    )

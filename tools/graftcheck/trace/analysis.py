@@ -1,0 +1,442 @@
+"""GC011-GC013 over built artifacts + the inventory trace driver.
+
+This module imports jax and must only be loaded behind ``--trace``
+(``trace/__init__.run_trace`` imports it lazily); the descriptors and
+budget logic stay jax-free so ``--list-rules`` and the unit tests work
+in jax-less environments.
+
+What each rule proves, and why the SOURCE-level twin cannot:
+
+* **GC011 donation-audit** — for every graph whose production wrapper
+  declares ``donate_argnums``, every donated buffer must appear in the
+  compiled executable's input->output alias map.  XLA silently DECLINES
+  donations it cannot honor (a lowering UserWarning at best); a declined
+  donation on the [P, P, G] planes doubles the hot path's HBM at 100k
+  groups with zero test-visible effect.  No AST pass can see what XLA
+  decided — only the compiled artifact knows.
+* **GC012 constant-capture** — no jaxpr const (at any nesting depth)
+  above the spec's byte budget.  A closed-over device array is baked
+  into the graph: HBM-resident per executable, re-traced and re-compiled
+  for every new closure value (compile-cache defeat), invisible in the
+  call signature.
+* **GC013 host-sync-in-graph** — no callback/debug/transfer primitive
+  anywhere in a hot graph.  The runtime-truth twin of AST rule GC002:
+  GC002 bans the host-sync SPELLINGS in the kernel modules, but a
+  callback smuggled through a helper in another module still lands an
+  eqn in the traced graph — and that eqn, not the spelling, is what
+  serializes every dispatch.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.tree_util as jtu
+
+from ..core import Context, Violation
+from . import budget as budget_mod
+from .inventory import DONATION_ALLOW, REGISTRY, Built, GraphSpec
+
+GC011, GC011_SLUG = "GC011", "donation-audit"
+GC012, GC012_SLUG = "GC012", "constant-capture"
+GC013, GC013_SLUG = "GC013", "host-sync-in-graph"
+
+# Primitives that move control or data across the host boundary (or pin a
+# transfer) inside a traced graph.  `debug_print` is jax.debug.print's
+# pre-0.4.31 spelling; kept so an old-jax trace still fails loudly.
+HOST_SYNC_PRIMITIVES: Set[str] = {
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "debug_print",
+    "callback",
+    "infeed",
+    "outfeed",
+    "device_put",
+    "copy_to_host_async",
+}
+
+_ALIAS_ENTRY_RE = re.compile(r"\{[0-9,\s]*\}:\s*\(([0-9]+),")
+
+
+# --- jaxpr walking ----------------------------------------------------------
+
+
+def _sub_jaxprs(params: dict) -> Iterator[object]:
+    """Every Jaxpr/ClosedJaxpr reachable through one eqn's params (cond
+    branches, scan/while bodies, pjit calls, pallas kernels, custom_*)."""
+    for value in params.values():
+        items: Iterable[object] = (
+            value if isinstance(value, (list, tuple)) else (value,)
+        )
+        for item in items:
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                yield item
+
+
+def walk_jaxprs(closed) -> Iterator[object]:
+    """Preorder over the ClosedJaxpr/Jaxpr tree, root first."""
+    stack = [closed]
+    while stack:
+        node = stack.pop()
+        yield node
+        jaxpr = getattr(node, "jaxpr", node)
+        for eqn in getattr(jaxpr, "eqns", ()):
+            stack.extend(_sub_jaxprs(eqn.params))
+
+
+def count_eqns(closed) -> int:
+    """Total equations at every nesting depth — the budget metric.  The
+    recursive count (not the top-level one) is what tracks compile time:
+    XLA compiles every sub-jaxpr, and a cond counts both branches."""
+    return sum(
+        len(getattr(getattr(node, "jaxpr", node), "eqns", ()))
+        for node in walk_jaxprs(closed)
+    )
+
+
+def collect_consts(closed) -> List[object]:
+    """Every array-valued const at every nesting depth."""
+    out = []
+    for node in walk_jaxprs(closed):
+        for const in getattr(node, "consts", ()):
+            if hasattr(const, "nbytes"):
+                out.append(const)
+    return out
+
+
+def collect_primitives(closed) -> Set[str]:
+    prims: Set[str] = set()
+    for node in walk_jaxprs(closed):
+        jaxpr = getattr(node, "jaxpr", node)
+        for eqn in getattr(jaxpr, "eqns", ()):
+            prims.add(eqn.primitive.name)
+    return prims
+
+
+# --- the rules --------------------------------------------------------------
+
+
+def _v(spec: GraphSpec, rule_id: str, slug: str, message: str) -> Violation:
+    return Violation(spec.anchor, 1, rule_id, slug, message)
+
+
+def parse_alias_params(hlo_text: str) -> Set[int]:
+    """Parameter numbers appearing in the compiled module's
+    ``input_output_alias={ {out}: (param, {index}, kind), ... }`` header.
+    The segment is extracted with a brace counter (entries themselves
+    contain ``{}``), so stray braces elsewhere cannot confuse it."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = hlo_text.index("{", start)
+    depth, j = 0, i
+    for j in range(i, min(len(hlo_text), i + 200_000)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    segment = hlo_text[i : j + 1]
+    return {int(g.group(1)) for g in _ALIAS_ENTRY_RE.finditer(segment)}
+
+
+def check_donation(
+    spec: GraphSpec, built: Built, compiled_text: str, args_info
+) -> Tuple[List[Violation], Set[Tuple[str, str]]]:
+    """GC011 over one compiled artifact; returns (violations, declined
+    keys) — declined keys include allow-listed declines, so the stale
+    check can tell a used allow entry from a rotten one.
+
+    ``args_info`` is ``Lowered.args_info`` — its flattened order IS the
+    executable's parameter numbering, and each leaf carries the
+    ``donated`` flag jax actually lowered with (so registry drift from
+    the production wrapper is caught too)."""
+    violations: List[Violation] = []
+    declined: Set[Tuple[str, str]] = set()
+    flat = jtu.tree_flatten_with_path(args_info)[0]
+    donated_params: Dict[int, str] = {}
+    declared_argnums: Set[int] = set()
+    for param_no, (path, info) in enumerate(flat):
+        path_str = jtu.keystr(path)
+        if getattr(info, "donated", False):
+            donated_params[param_no] = path_str
+            # args_info nests the positional args one level down (the
+            # outer [0] is the args tuple itself), so the ARGNUM is the
+            # second path entry, not the first.
+            if len(path) >= 2:
+                argnum = getattr(path[1], "idx", None)
+                if argnum is not None:
+                    declared_argnums.add(int(argnum))
+    if declared_argnums != set(built.donate):
+        violations.append(
+            _v(
+                spec,
+                GC011,
+                GC011_SLUG,
+                f"graph {spec.name!r}: the registry declares donate_argnums="
+                f"{tuple(sorted(built.donate))} but the lowering donated "
+                f"argnums {tuple(sorted(declared_argnums))} — the production "
+                "wrapper and the inventory entry disagree; fix whichever "
+                "drifted (tools/graftcheck/trace/inventory.py)",
+            )
+        )
+    aliased = parse_alias_params(compiled_text)
+    for param_no, path_str in sorted(donated_params.items()):
+        if param_no in aliased:
+            continue
+        key = (spec.name, path_str)
+        declined.add(key)
+        if str(DONATION_ALLOW.get(key, "")).strip():
+            continue
+        violations.append(
+            _v(
+                spec,
+                GC011,
+                GC011_SLUG,
+                f"graph {spec.name!r}: donated buffer {path_str} (parameter "
+                f"{param_no}) is MISSING from the executable's input->output "
+                "alias map — XLA declined the donation, so this plane is "
+                "double-buffered every call (2x HBM at production G); make "
+                "an output of matching shape/dtype reuse it, stop donating "
+                "it, or register the decline in DONATION_ALLOW with a reason",
+            )
+        )
+    return violations, declined
+
+
+def check_stale_donation_allows(
+    declined_seen: Set[Tuple[str, str]],
+    audited: Set[str],
+    spec_names: Set[str],
+) -> Iterator[Violation]:
+    """A DONATION_ALLOW entry that matches no currently-declined donation
+    is rot (the GC000 discipline for the trace layer's escape hatch).
+    That includes entries whose graph NAME matches nothing traced — a
+    typo'd or removed graph, or one with no donation audit at all —
+    which would otherwise suppress nothing and rot forever."""
+    for key, reason in sorted(DONATION_ALLOW.items()):
+        name, path_str = key
+        if name not in audited and name in spec_names:
+            yield Violation(
+                "tools/graftcheck/trace/inventory.py",
+                1,
+                GC011,
+                GC011_SLUG,
+                f"DONATION_ALLOW entry {key!r} names graph {name!r}, whose "
+                "registry row sets audit_donation=False — the entry can "
+                "never match a decline; delete it (or re-enable the audit)",
+            )
+        elif name not in spec_names:
+            yield Violation(
+                "tools/graftcheck/trace/inventory.py",
+                1,
+                GC011,
+                GC011_SLUG,
+                f"DONATION_ALLOW entry {key!r} names no inventoried graph "
+                f"({name!r} is not in the registry) — typo'd or removed; "
+                "delete the stale entry",
+            )
+        elif key not in declined_seen:
+            yield Violation(
+                "tools/graftcheck/trace/inventory.py",
+                1,
+                GC011,
+                GC011_SLUG,
+                f"DONATION_ALLOW entry {key!r} matches no declined "
+                "donation — XLA accepts this buffer now; delete the stale "
+                "entry",
+            )
+        if not str(reason).strip():
+            yield Violation(
+                "tools/graftcheck/trace/inventory.py",
+                1,
+                GC011,
+                GC011_SLUG,
+                f"DONATION_ALLOW entry {key!r} has no justification; "
+                "explain why XLA declines it and why that is acceptable",
+            )
+
+
+def check_consts(spec: GraphSpec, closed) -> Iterator[Violation]:
+    """GC012 over one traced graph."""
+    for const in collect_consts(closed):
+        nbytes = int(const.nbytes)
+        if nbytes <= spec.const_budget:
+            continue
+        shape = tuple(getattr(const, "shape", ()))
+        dtype = getattr(const, "dtype", "?")
+        yield _v(
+            spec,
+            GC012,
+            GC012_SLUG,
+            f"graph {spec.name!r} bakes a {nbytes}-byte const "
+            f"({dtype}{list(shape)}) into its jaxpr (budget "
+            f"{spec.const_budget}B) — a closed-over plane is HBM-resident "
+            "per executable and defeats the compile cache; pass it as an "
+            "argument (cf. chaos.make_runner's schedule args)",
+        )
+
+
+def check_host_sync(spec: GraphSpec, closed) -> Iterator[Violation]:
+    """GC013 over one traced graph."""
+    bad = sorted(collect_primitives(closed) & HOST_SYNC_PRIMITIVES)
+    for prim in bad:
+        yield _v(
+            spec,
+            GC013,
+            GC013_SLUG,
+            f"graph {spec.name!r} contains a `{prim}` equation — a "
+            "host-boundary primitive inside a hot graph serializes every "
+            "dispatch (the runtime twin of GC002); hoist it to the drain "
+            "boundary or behind an instrumentation flag",
+        )
+
+
+# --- the driver -------------------------------------------------------------
+
+
+def trace_inventory(
+    specs: Optional[Sequence[GraphSpec]] = None,
+) -> Tuple[List[Violation], Dict[str, int]]:
+    """Build every inventoried graph and run GC011-GC013; returns the
+    violations plus the measured eqn counts for GC014 (budget.py)."""
+    if specs is None:
+        specs = REGISTRY
+    try:
+        # GC011 pays real XLA compiles; the opt-in persistent cache
+        # (RAFT_TPU_COMPILE_CACHE — same cache CI shares with the tier-1
+        # job) makes repeated trace runs cheap.  Best-effort by design.
+        from raft_tpu import platform
+
+        platform.enable_compile_cache()
+    except Exception:
+        pass
+    violations: List[Violation] = []
+    measured: Dict[str, int] = {}
+    declined_seen: Set[Tuple[str, str]] = set()
+    audited: Set[str] = set()
+    for spec in specs:
+        try:
+            built = spec.build()
+            closed = jax.make_jaxpr(built.fn)(*built.args)
+        except Exception as e:  # a graph that fails to TRACE is a finding
+            violations.append(
+                _v(
+                    spec,
+                    "GC000",
+                    "trace-build-error",
+                    f"graph {spec.name!r} failed to build/trace: "
+                    f"{type(e).__name__}: {e}",
+                )
+            )
+            continue
+        measured[spec.name] = count_eqns(closed)
+        violations.extend(check_consts(spec, closed))
+        violations.extend(check_host_sync(spec, closed))
+        if spec.audit_donation:
+            audited.add(spec.name)
+            try:
+                with warnings.catch_warnings():
+                    # The "donated buffers were not usable" UserWarning is
+                    # what GC011 turns into a structured violation below.
+                    warnings.simplefilter("ignore")
+                    lowered = built.fn.lower(*built.args)
+                    # The drift check must be BIDIRECTIONAL: a wrapper
+                    # that starts donating while its registry row still
+                    # declares none is drift too, so every graph pays the
+                    # cheap lower(); the expensive compile (alias map)
+                    # runs only when either side declares a donation.
+                    flat_info = jtu.tree_flatten_with_path(
+                        lowered.args_info
+                    )[0]
+                    lowering_donates = any(
+                        getattr(info, "donated", False)
+                        for _, info in flat_info
+                    )
+                    compiled_text = (
+                        lowered.compile().as_text()
+                        if built.donate or lowering_donates
+                        else ""
+                    )
+            except Exception as e:
+                violations.append(
+                    _v(
+                        spec,
+                        "GC000",
+                        "trace-build-error",
+                        f"graph {spec.name!r} failed to compile for the "
+                        f"donation audit: {type(e).__name__}: {e}",
+                    )
+                )
+                continue
+            donation_violations, declined = check_donation(
+                spec, built, compiled_text, lowered.args_info
+            )
+            violations.extend(donation_violations)
+            declined_seen.update(declined)
+    violations.extend(
+        check_stale_donation_allows(
+            declined_seen, audited, {spec.name for spec in specs}
+        )
+    )
+    return violations, measured
+
+
+def run_trace(
+    ctx: Context,
+    update_budget: bool = False,
+    diff_out: Optional[str] = None,
+    specs: Optional[Sequence[GraphSpec]] = None,
+) -> List[Violation]:
+    """The ``--trace`` entry point: trace/compile the inventory, run
+    GC011-GC013, then GC014 against the committed budget (or regenerate
+    it with ``update_budget``).  ``diff_out`` writes the budget-diff
+    artifact JSON (CI uploads it)."""
+    import json
+    from pathlib import Path
+
+    violations, measured = trace_inventory(specs)
+    bpath = budget_mod.budget_path(ctx.repo_root)
+    versions = jax_versions()
+    if update_budget:
+        bpath.parent.mkdir(parents=True, exist_ok=True)
+        bpath.write_text(
+            budget_mod.render_budget(measured, versions), encoding="utf-8"
+        )
+    doc = budget_mod.load_budget(bpath)
+    anchor = "tools/graftcheck/" + budget_mod.BUDGET_NAME
+    budget_violations, diff = budget_mod.check_budget(
+        measured, doc, anchor, measured_versions=versions
+    )
+    violations.extend(budget_violations)
+    if diff.get("version_mismatch"):
+        import sys
+
+        print(
+            f"graftcheck: --trace measured under {versions} but the "
+            f"committed budget was stamped {diff.get('versions')} — eqn "
+            "deltas may be upstream jax changes (the diff artifact records "
+            "the mismatch)",
+            file=sys.stderr,
+        )
+    if diff_out:
+        diff["measured_versions"] = versions
+        out = Path(diff_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            json.dumps(diff, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+    violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
+    return violations
+
+
+def jax_versions() -> Dict[str, str]:
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__}

@@ -1,0 +1,192 @@
+"""GC014: the committed jaxpr-size budget (tools/graftcheck/jaxpr_budget.json).
+
+The budget file is the compile-time twin of BENCH_baseline.json: one
+committed equation count per inventoried graph, checked on every trace run
+and regenerated only deliberately (``--update-budget`` / ``make
+jaxpr-budget``), so jaxpr growth — which is compile time, which is tier-1
+budget (docs/PERF.md) — is paid visibly in review instead of silently in
+compile seconds.  ISSUE 6 bought the link path down 2716 -> 356 eqns;
+this file is what holds that class of line.
+
+Pure stdlib on purpose: the check/diff logic must be unit-testable (and
+the budget replayable in CI artifacts) without importing jax — only the
+MEASUREMENT (trace/analysis.py) needs jax.
+
+File format::
+
+    {
+      "format": 1,
+      "versions": {"jax": "0.4.37", "jaxlib": "0.4.36"},
+      "tolerance_pct": 15.0,
+      "graphs": {"step@plain": {"eqns": 1567}, ...}
+    }
+
+Failure modes (each a GC014 violation): a measured graph above its entry
+by more than ``tolerance_pct``; an inventoried graph with no entry (new
+graphs must be budgeted in the same PR); a budget entry naming no
+inventoried graph (stale — regenerate).  Shrinkage never fails (mirroring
+the bench gate, which only gates regressions) but is recorded in the diff
+artifact so an intentional reduction can be re-baselined.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Violation
+
+BUDGET_NAME = "jaxpr_budget.json"
+BUDGET_FORMAT = 1
+DEFAULT_TOLERANCE_PCT = 15.0
+
+GC014 = "GC014"
+GC014_SLUG = "jaxpr-budget"
+
+
+def budget_path(repo_root: Path) -> Path:
+    return repo_root / "tools" / "graftcheck" / BUDGET_NAME
+
+
+def load_budget(path: Path) -> Optional[dict]:
+    """The parsed budget document, or None when missing/unreadable (the
+    caller reports that as a violation — a missing budget must not read
+    as green)."""
+    try:
+        doc = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or doc.get("format") != BUDGET_FORMAT:
+        return None
+    if not isinstance(doc.get("graphs"), dict):
+        return None
+    return doc
+
+
+def render_budget(
+    measured: Dict[str, int], versions: Dict[str, str],
+    tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+) -> str:
+    doc = {
+        "format": BUDGET_FORMAT,
+        "versions": versions,
+        "tolerance_pct": tolerance_pct,
+        "graphs": {
+            name: {"eqns": int(n)} for name, n in sorted(measured.items())
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def check_budget(
+    measured: Dict[str, int],
+    doc: Optional[dict],
+    anchor_path: str,
+    measured_versions: Optional[Dict[str, str]] = None,
+) -> Tuple[List[Violation], dict]:
+    """(violations, diff document) for a measurement against the committed
+    budget.  ``anchor_path`` is where violations anchor (the budget file's
+    repo-relative path).  ``measured_versions`` is the measuring
+    environment's jax/jaxlib versions: when they differ from the budget's
+    recorded stamp, an over-budget finding may be an upstream lowering
+    change rather than a repo change, so the mismatch is recorded in the
+    diff (``version_mismatch``) and appended to every over-budget message
+    — the gate still fails (growth is growth), but the verdict says where
+    to look."""
+
+    def v(message: str) -> Violation:
+        return Violation(anchor_path, 1, GC014, GC014_SLUG, message)
+
+    violations: List[Violation] = []
+    diff: dict = {"graphs": {}, "versions": {}}
+    if doc is None:
+        violations.append(
+            v(
+                "committed jaxpr budget is missing or unreadable; "
+                "regenerate with `make jaxpr-budget` and commit it"
+            )
+        )
+        for name, eqns in sorted(measured.items()):
+            diff["graphs"][name] = {
+                "budget": None, "measured": eqns, "status": "new",
+            }
+        return violations, diff
+    tolerance = float(doc.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+    diff["tolerance_pct"] = tolerance
+    diff["versions"] = doc.get("versions", {})
+    mismatch = bool(
+        measured_versions
+        and diff["versions"]
+        and measured_versions != diff["versions"]
+    )
+    diff["version_mismatch"] = mismatch
+    version_note = (
+        (
+            f" [NOTE: installed {measured_versions} differ from the "
+            f"budget's recorded {diff['versions']} — this may be an "
+            "upstream jax lowering change, not a repo change; re-baseline "
+            "with `make jaxpr-budget` at the new versions if so]"
+        )
+        if mismatch
+        else ""
+    )
+    graphs = doc["graphs"]
+    for name, eqns in sorted(measured.items()):
+        entry = graphs.get(name)
+        if not isinstance(entry, dict) or "eqns" not in entry:
+            violations.append(
+                v(
+                    f"graph {name!r} has no budget entry — every inventoried "
+                    "graph must be budgeted in the PR that adds it "
+                    "(`make jaxpr-budget`)"
+                )
+            )
+            diff["graphs"][name] = {
+                "budget": None, "measured": eqns, "status": "new",
+            }
+            continue
+        budget = int(entry["eqns"])
+        delta_pct = (
+            (eqns - budget) * 100.0 / budget if budget else float(eqns > 0)
+        )
+        status = "ok"
+        if eqns > budget * (1.0 + tolerance / 100.0):
+            status = "over"
+            violations.append(
+                v(
+                    f"graph {name!r} traced to {eqns} eqns, "
+                    f"{delta_pct:+.1f}% over its budget of {budget} "
+                    f"(tolerance {tolerance:.0f}%) — jaxpr growth is compile "
+                    "time is tier-1 budget (docs/PERF.md); shrink the graph "
+                    "or pay for it visibly with `make jaxpr-budget`"
+                    + version_note
+                )
+            )
+        elif eqns < budget * (1.0 - tolerance / 100.0):
+            # An improvement never fails (the bench-gate convention), but a
+            # stale high baseline hands the next regression free headroom —
+            # the diff artifact flags it for re-baselining.
+            status = "shrunk"
+        diff["graphs"][name] = {
+            "budget": budget,
+            "measured": eqns,
+            "delta_pct": round(delta_pct, 2),
+            "status": status,
+        }
+    for name in sorted(set(graphs) - set(measured)):
+        violations.append(
+            v(
+                f"budget entry {name!r} names no inventoried graph — stale "
+                "after an inventory change; regenerate with "
+                "`make jaxpr-budget`"
+            )
+        )
+        diff["graphs"][name] = {
+            "budget": int(graphs[name].get("eqns", 0))
+            if isinstance(graphs[name], dict)
+            else None,
+            "measured": None,
+            "status": "stale",
+        }
+    return violations, diff

@@ -1,0 +1,384 @@
+"""The canonical graph inventory: every jitted hot-path entry point, as data.
+
+Each ``GraphSpec`` names one compiled artifact of the production system —
+entry point + flag combination + the donation structure its production
+wrapper declares — and a builder that constructs it EXACTLY the way the
+production wrapper does (``ClusterSim``'s jits, ``chaos.make_runner``,
+``sharding.sharded_step``, the fused dispatchers), at a tiny audit shape
+(G=8, P=3: jaxpr size and donation structure are shape-independent, so
+the audit shape only has to be cheap).  ``trace/analysis.py`` runs
+GC011-GC014 over the built artifacts; ``jaxpr_budget.json`` is keyed by
+``GraphSpec.name``.
+
+This registry is deliberately declarative — the flag matrix
+(plain/counters/health/chaos x undamped/cq/cq+pv) and each graph's
+expected donate_argnums live HERE, not scattered through the builders —
+as the first concrete piece of ROADMAP item 5's promote-the-registry-to-
+source-of-truth refactor: a new plane or flag lands as one more row, and
+the trace gates come for free.
+
+Builders import jax/raft_tpu lazily so this module (and the rule
+registry that imports it) stays importable in jax-less environments;
+nothing here traces until ``trace.run_trace`` calls ``build()``.
+
+GC011's escape hatch is the registry below, not line markers (violations
+anchor at machine-chosen lines, so inline markers would be brittle):
+``DONATION_ALLOW[(graph_name, param_path)] = "<why XLA declines this and
+why that is acceptable>"``.  A stale entry — one matching no currently
+declined donation — is itself a violation, exactly like a typo'd
+allow-marker (GC000's discipline).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, NamedTuple, Tuple
+
+# The audit shape: tiny on purpose (see module docstring).
+G = 8
+P = 3
+SCAN_ROUNDS = 4  # run_compiled segment length in the audit graphs
+DISPATCH_K = 4  # fused-dispatcher horizon in the audit graphs
+
+# Per-graph jaxpr-const byte budget (GC012).  The healthy graphs carry
+# only scalar/iota-sized consts (<= 64B observed across the whole
+# inventory); anything larger is a closed-over plane — schedule arrays,
+# masks, workloads — that bloats HBM at production G and defeats the
+# compile cache (a new closure value is a new executable).  The budget
+# must sit BELOW the smallest per-group plane at the audit shape or the
+# rule cannot catch its own quarry: bool[P, P, G] is 72B and
+# int32[P, G] is 96B at G=8/P=3, so 64B is the largest budget that
+# still flags every accidentally-closed-over G-shaped plane.
+DEFAULT_CONST_BYTES = 64
+
+# GC011 allow-registry: (graph name, flattened param path) -> justification.
+# Empty today — every declared donation in the inventory is accepted by
+# XLA (the alias-map audit proves it); add entries here, with a reason,
+# only for donations XLA genuinely cannot honor.
+DONATION_ALLOW: Dict[Tuple[str, str], str] = {}
+
+
+class Built(NamedTuple):
+    """One constructed artifact: the (jitted) callable, example args at
+    the audit shape, and the donate_argnums its production wrapper
+    declares — the registry's expectation, checked against the actual
+    lowering by GC011."""
+
+    fn: Callable
+    args: tuple
+    donate: Tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class GraphSpec:
+    name: str  # budget key, e.g. "step@health+cq"
+    anchor: str  # repo-relative module the entry point lives in
+    build: Callable[[], Built]
+    # GC011 lowers every audited graph (bidirectional drift check); the
+    # compile (alias map) runs only when either side declares a donation.
+    audit_donation: bool = True
+    const_budget: int = DEFAULT_CONST_BYTES
+
+
+# --- builders ---------------------------------------------------------------
+
+
+def _sim():
+    from raft_tpu.multiraft import sim
+
+    return sim
+
+
+def _base_args(cfg):
+    import jax.numpy as jnp
+
+    sim = _sim()
+    st = sim.init_state(cfg)
+    crashed = jnp.zeros((P, G), bool)
+    append_n = jnp.zeros((G,), jnp.int32)
+    return st, crashed, append_n
+
+
+def _full_link():
+    import jax.numpy as jnp
+
+    return jnp.ones((P, P, G), bool)
+
+
+def _step_builder(flags: dict, damping: dict, chaos: bool):
+    def build() -> Built:
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P, **flags, **damping)
+        cs = sim.ClusterSim(cfg)
+        st, crashed, append_n = _base_args(cfg)
+        link = _full_link() if chaos else None
+        cc, ch = cfg.collect_counters, cfg.collect_health
+        if cc and ch:
+            return Built(
+                cs._step_both,
+                (st, crashed, append_n, cs._counters, cs._health, link),
+                (0, 3, 4),
+            )
+        if cc:
+            return Built(
+                cs._step_counted,
+                (st, crashed, append_n, cs._counters, link),
+                (0, 3),
+            )
+        if ch:
+            return Built(
+                cs._step_health,
+                (st, crashed, append_n, cs._health, link),
+                (0, 3),
+            )
+        return Built(
+            cs._step,
+            (st, crashed, append_n, None, None, None, link),
+            (0,),
+        )
+
+    return build
+
+
+def _run_compiled_builder(flags: dict, damping: dict):
+    def build() -> Built:
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P, **flags, **damping)
+        cs = sim.ClusterSim(cfg)
+        st, crashed, append_n = _base_args(cfg)
+        runner = cs._compiled_runner(SCAN_ROUNDS, has_link=False)
+        args: tuple = (st, crashed, append_n)
+        donate: Tuple[int, ...] = (0,)
+        if cfg.collect_counters:
+            args = args + (cs._counters,)
+            donate = donate + (len(args) - 1,)
+        if cfg.collect_health:
+            args = args + (cs._health,)
+            donate = donate + (len(args) - 1,)
+        return Built(runner, args, donate)
+
+    return build
+
+
+def _read_index_builder(chaos: bool):
+    def build() -> Built:
+        import functools
+
+        import jax
+
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P)
+        st, crashed, _ = _base_args(cfg)
+        fn = jax.jit(functools.partial(sim.read_index, cfg))
+        args = (st, crashed) + ((_full_link(),) if chaos else ())
+        return Built(fn, args)
+
+    return build
+
+
+def _dispatcher_builder(damping: dict, with_health: bool):
+    def build() -> Built:
+        import jax
+
+        from raft_tpu.multiraft import pallas_step
+
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P, **damping)
+        # interpret-mode pallas off-TPU: the pallas_call wrapping differs
+        # but the kernel jaxpr inside (what GC014 counts) does not.
+        fn = pallas_step.fast_multi_round(
+            cfg,
+            k=DISPATCH_K,
+            with_health=with_health,
+            interpret=jax.default_backend() != "tpu",
+        )
+        st, crashed, append_n = _base_args(cfg)
+        args: tuple = (st, crashed, append_n)
+        if with_health:
+            args = args + (sim.init_health(cfg),)
+        return Built(jax.jit(fn), args)
+
+    return build
+
+
+def _chaos_runner_builder():
+    def build() -> Built:
+        from raft_tpu.multiraft import chaos
+
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P, collect_health=True)
+        st, _, _ = _base_args(cfg)
+        plan = chaos.ChaosPlan(
+            name="graftcheck-inventory",
+            n_peers=P,
+            phases=[
+                chaos.ChaosPhase(
+                    rounds=6, partition=[[1], [2, 3]], loss_all=0.05
+                ),
+                chaos.ChaosPhase(rounds=6, append=1),
+            ],
+        )
+        compiled = chaos.compile_plan(plan, G)
+        runner = chaos.make_runner(cfg, compiled)
+        # make_runner exposes its underlying jit and full argument list
+        # (state, health, *schedule arrays) precisely for this audit.
+        return Built(
+            runner.jitted,
+            (st, sim.init_health(cfg)) + runner.schedule_args,
+            (0, 1),
+        )
+
+    return build
+
+
+def _sharded_builder(kind: str):
+    def build() -> Built:
+        import jax
+
+        from raft_tpu.multiraft import sharding
+
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G, n_peers=P)
+        mesh = sharding.make_mesh(1, devices=jax.devices())
+        st, crashed, append_n = _base_args(cfg)
+        st = sharding.shard_state(st, mesh)
+        if kind == "step":
+            return Built(
+                sharding.sharded_step(cfg, mesh), (st, crashed, append_n),
+                (0,),
+            )
+        if kind == "status":
+            return Built(sharding.global_status(cfg, mesh), (st,))
+        return Built(
+            sharding.sharded_read_index(cfg, mesh), (st, crashed)
+        )
+
+    return build
+
+
+# --- the registry -----------------------------------------------------------
+
+_INSTRUMENT_FLAGS: List[Tuple[str, dict, bool]] = [
+    # (label, SimConfig flags, link plane threaded)
+    ("plain", {}, False),
+    ("counters", {"collect_counters": True}, False),
+    ("health", {"collect_health": True}, False),
+    ("chaos", {}, True),
+]
+
+_DAMPING_FLAGS: List[Tuple[str, dict]] = [
+    ("", {}),
+    ("cq", {"check_quorum": True}),
+    ("cq+pv", {"check_quorum": True, "pre_vote": True}),
+]
+
+
+def _specs() -> List[GraphSpec]:
+    sim_py = "raft_tpu/multiraft/sim.py"
+    out: List[GraphSpec] = []
+    for ilabel, iflags, chaos in _INSTRUMENT_FLAGS:
+        for dlabel, dflags in _DAMPING_FLAGS:
+            name = f"step@{ilabel}" + (f"+{dlabel}" if dlabel else "")
+            out.append(
+                GraphSpec(
+                    name=name,
+                    anchor=sim_py,
+                    build=_step_builder(iflags, dflags, chaos),
+                )
+            )
+    out.append(
+        GraphSpec(
+            name="run_compiled@plain",
+            anchor=sim_py,
+            build=_run_compiled_builder({}, {}),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The chunked counter-drain segment (docs/PERF.md "Donated
+            # scan carries"): the whole carry — state + counter + health
+            # planes — must stay donated, or run_compiled doubles its HBM.
+            name="run_compiled@counters+health",
+            anchor=sim_py,
+            build=_run_compiled_builder(
+                {"collect_counters": True, "collect_health": True}, {}
+            ),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The packed recent_active carry (ISSUE 8): donated bool plane
+            # in, packed words inside, unpacked plane out — the aliasing
+            # across the pack boundary is exactly what GC011 verifies.
+            name="run_compiled@plain+cq+pv",
+            anchor=sim_py,
+            build=_run_compiled_builder(
+                {}, {"check_quorum": True, "pre_vote": True}
+            ),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name="read_index@plain", anchor=sim_py,
+            build=_read_index_builder(False),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name="read_index@chaos", anchor=sim_py,
+            build=_read_index_builder(True),
+        )
+    )
+    pallas_py = "raft_tpu/multiraft/pallas_step.py"
+    out.append(
+        GraphSpec(
+            # fast_multi_round's cond carries BOTH branches (fused kernel
+            # + k general steps) in one graph — the budget covers both.
+            name=f"dispatch{DISPATCH_K}@plain",
+            anchor=pallas_py,
+            build=_dispatcher_builder({}, with_health=False),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name=f"dispatch{DISPATCH_K}@health+cq+pv",
+            anchor=pallas_py,
+            build=_dispatcher_builder(
+                {"check_quorum": True, "pre_vote": True}, with_health=True
+            ),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name="chaos_runner@health",
+            anchor="raft_tpu/multiraft/chaos.py",
+            build=_chaos_runner_builder(),
+        )
+    )
+    sharding_py = "raft_tpu/multiraft/sharding.py"
+    out.append(
+        GraphSpec(
+            name="sharded_step@plain", anchor=sharding_py,
+            build=_sharded_builder("step"),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name="sharded_status@plain", anchor=sharding_py,
+            build=_sharded_builder("status"),
+        )
+    )
+    out.append(
+        GraphSpec(
+            name="sharded_read_index@plain", anchor=sharding_py,
+            build=_sharded_builder("read_index"),
+        )
+    )
+    return out
+
+
+REGISTRY: List[GraphSpec] = _specs()
+
+
+def graph_names() -> List[str]:
+    return [spec.name for spec in REGISTRY]

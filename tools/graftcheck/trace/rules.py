@@ -1,0 +1,63 @@
+"""Trace-rule descriptors (GC011-GC014).
+
+Like the engine descriptors (engine/rules.py), these subclass ``Rule`` so
+``--list-rules`` and allow-marker validation treat trace rules like any
+other rule, but their per-file ``applies()`` is always False: trace rules
+run over LOWERED artifacts — jaxprs and compiled executables of the graph
+inventory (trace/inventory.py) — through ``trace.run_trace`` (the
+``--trace`` flag), not over source files.  This module must stay
+importable without jax (the registry loads it for --list-rules in
+jax-less environments); everything that traces lives in
+``trace/analysis.py`` and is imported lazily.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule, SourceFile
+
+
+class DonationAuditRule(Rule):
+    id = "GC011"
+    slug = "donation-audit"
+    doc = "every declared donate_argnums buffer appears in the compiled alias map (--trace)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False  # artifact-level: runs via trace.run_trace
+
+
+class ConstantCaptureRule(Rule):
+    id = "GC012"
+    slug = "constant-capture"
+    doc = "no jaxpr consts above the per-graph byte budget (closed-over planes) (--trace)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+class HostSyncInGraphRule(Rule):
+    id = "GC013"
+    slug = "host-sync-in-graph"
+    doc = "no callback/debug/transfer primitives inside the hot graphs (--trace)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+class JaxprBudgetRule(Rule):
+    id = "GC014"
+    slug = "jaxpr-budget"
+    doc = "traced graph sizes hold the committed jaxpr_budget.json line (--trace)"
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+def trace_rules() -> List[Rule]:
+    return [
+        DonationAuditRule(),
+        ConstantCaptureRule(),
+        HostSyncInGraphRule(),
+        JaxprBudgetRule(),
+    ]

@@ -1,0 +1,185 @@
+"""Tier-1 wall-time budget report: is the 870s gate about to saturate?
+
+Every PR since ISSUE 2 has had to hand-audit the tier-1 gate ("the 870s
+budget is saturated — every second added must be paid for", ROADMAP.md);
+this tool turns that audit into a CI step.  It parses the pytest output
+of the tier-1 run (the ROADMAP command tees it to ``/tmp/_t1.log``; CI
+adds ``--durations=25`` so the per-test breakdown is available), prints
+the top-N costliest tests, and **exits 1** when the estimated tier-1
+wall time exceeds the committed soft ceiling — 820s of the 870s gate —
+so gate saturation is caught in review instead of by a timeout five PRs
+later.
+
+Estimation, in preference order:
+
+1. the pytest summary line's own wall time (``... in 690.12s ...``) —
+   authoritative, includes collection and fixture overhead;
+2. the sum of the ``slowest durations`` block otherwise (an UNDERCOUNT:
+   pytest hides sub-5ms phases and ``--durations=N`` truncates, so a
+   pass on this estimate is weaker than a pass on the summary line).
+
+A log with neither is unparseable and exits 2 — a scraping failure must
+never read as a green budget (the make-typecheck discipline).
+
+Usage::
+
+    python tools/tier1_budget.py /tmp/_t1.log [--top 15] [--ceiling 820]
+        [--json artifacts/tier1-budget.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# The committed soft ceiling: 50s of headroom under the 870s hard gate
+# (ROADMAP.md tier-1 command) absorbs runner jitter and one more PR's
+# compile drift without the timeout firing mid-suite.
+HARD_GATE_S = 870.0
+SOFT_CEILING_S = 820.0
+
+# ``12.34s call     tests/test_x.py::test_y`` — one line per (phase, test)
+# in the ``slowest durations`` block.
+_DURATION_RE = re.compile(
+    r"^\s*(?P<secs>\d+(?:\.\d+)?)s\s+"
+    r"(?P<phase>call|setup|teardown)\s+"
+    r"(?P<nodeid>\S+)\s*$"
+)
+
+# ``=== 482 passed, 30 deselected, 2 warnings in 690.12s (0:11:30) ===``
+# (default verbosity) or the bare ``482 passed, 30 deselected in 690.12s
+# (0:11:30)`` quiet form — the ROADMAP tier-1 command runs ``-q``, so the
+# bars are absent from the log this tool actually scrapes.
+_SUMMARY_RE = re.compile(
+    r"^(?:=+\s)?\d+\s+"
+    r"(?:passed|failed|errors?|skipped|xfailed|xpassed|deselected|warnings?)\b"
+    r".*\bin\s+(?P<secs>\d+(?:\.\d+)?)s(?:\s+\([0-9:]+\))?(?:\s=+)?\s*$"
+)
+
+
+def parse_log(text: str) -> Tuple[Optional[float], Dict[str, float]]:
+    """(summary wall seconds or None, per-test seconds summed over
+    setup/call/teardown phases)."""
+    wall: Optional[float] = None
+    per_test: Dict[str, float] = defaultdict(float)
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            per_test[m.group("nodeid")] += float(m.group("secs"))
+            continue
+        s = _SUMMARY_RE.search(line)
+        if s:
+            # Keep the LAST summary line: reruns/sections may print
+            # several and the final one covers the whole session.
+            wall = float(s.group("secs"))
+    return wall, dict(per_test)
+
+
+def top_tests(per_test: Dict[str, float], n: int) -> List[Tuple[str, float]]:
+    return sorted(per_test.items(), key=lambda kv: -kv[1])[:n]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tier1_budget",
+        description="tier-1 wall-time budget report over a pytest log",
+    )
+    ap.add_argument(
+        "log",
+        nargs="?",
+        default="/tmp/_t1.log",
+        help="pytest output of the tier-1 run (default: /tmp/_t1.log, "
+        "where the ROADMAP.md command tees it)",
+    )
+    ap.add_argument(
+        "--top", type=int, default=15, metavar="N",
+        help="how many costliest tests to print (default 15)",
+    )
+    ap.add_argument(
+        "--ceiling", type=float, default=SOFT_CEILING_S, metavar="S",
+        help=f"soft wall-time ceiling in seconds (default {SOFT_CEILING_S:g} "
+        f"of the {HARD_GATE_S:g}s gate)",
+    )
+    ap.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the report as JSON (CI uploads it)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        text = Path(args.log).read_text(encoding="utf-8", errors="replace")
+    except OSError as e:
+        print(f"tier1_budget: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+    wall, per_test = parse_log(text)
+    if wall is None and not per_test:
+        print(
+            f"tier1_budget: {args.log} has neither a pytest summary line "
+            "nor a `slowest durations` block — not a tier-1 log (run "
+            "pytest with --durations=N and tee the output)",
+            file=sys.stderr,
+        )
+        return 2
+    durations_sum = sum(per_test.values())
+    estimate = wall if wall is not None else durations_sum
+    basis = "pytest summary" if wall is not None else (
+        "sum of reported durations (undercount: sub-5ms phases hidden)"
+    )
+
+    print(
+        f"tier-1 wall time: {estimate:.1f}s of the {HARD_GATE_S:g}s gate "
+        f"(soft ceiling {args.ceiling:g}s) — basis: {basis}"
+    )
+    ranked = top_tests(per_test, args.top)
+    if ranked:
+        print(f"top {len(ranked)} costliest tests (setup+call+teardown):")
+        for nodeid, secs in ranked:
+            print(f"  {secs:8.2f}s  {nodeid}")
+    else:
+        print(
+            "no per-test durations in the log (pytest ran without "
+            "--durations=N); only the summary wall time was checked"
+        )
+    if args.json:
+        out = Path(args.json)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            json.dumps(
+                {
+                    "estimate_s": round(estimate, 2),
+                    "basis": basis,
+                    "hard_gate_s": HARD_GATE_S,
+                    "soft_ceiling_s": args.ceiling,
+                    "over_ceiling": estimate > args.ceiling,
+                    "durations_sum_s": round(durations_sum, 2),
+                    "top": [
+                        {"nodeid": n, "seconds": round(s, 2)}
+                        for n, s in ranked
+                    ],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n",
+            encoding="utf-8",
+        )
+    if estimate > args.ceiling:
+        print(
+            f"tier1_budget: OVER the {args.ceiling:g}s soft ceiling by "
+            f"{estimate - args.ceiling:.1f}s — pay for the added time "
+            "(slow-mark a case, trim rounds, or shave compile time; "
+            "ROADMAP.md standing constraint) before the 870s timeout "
+            "starts firing mid-suite",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
