@@ -86,6 +86,23 @@ Chaos mode (docs/OBSERVABILITY.md "Chaos") replaces the steady bench:
                   vs_baseline.
   --chaos-out F   also write the scenario-summary JSON to F (the CI
                   artifact next to the health summary).
+
+Reconfig mode (docs/OBSERVABILITY.md "Reconfig") likewise replaces the
+steady bench — BASELINE.json config 4 (100k groups under joint-consensus
+reconfig churn) measured end-to-end:
+
+  --reconfig F    run the membership-churn plan F (JSON,
+                  raft_tpu.multiraft.reconfig — either a bare
+                  ReconfigPlan document or {"reconfig": ..., "chaos":
+                  ...} to overlay an equal-length fault schedule) as ONE
+                  compiled lax.scan per rep; the JSON line carries the
+                  scenario summary (op-protocol counts, MTTR, the
+                  joint-window safety counts — all zero or the run exits
+                  2) under the `raft_reconfig_ticks_per_sec` metric key
+                  (`_cq` appended under --check-quorum), gated by
+                  --check like every other series.
+  --reconfig-out F  also write the scenario-summary JSON to F (the CI
+                  artifact).
 """
 
 import argparse
@@ -371,6 +388,86 @@ def bench_chaos(
     return {"report": report, **rep_stats(samples)}
 
 
+def bench_reconfig(
+    plan_path: str, groups: int, reps: int, reconfig_out: str = "",
+    check_quorum: bool = False,
+) -> dict:
+    """Run a membership-churn plan (optionally composed with a chaos
+    plan) as one compiled scan per rep — the BASELINE config 4 shape —
+    and report both the scenario summary and the reconfig-path
+    throughput."""
+    from raft_tpu.multiraft import chaos, reconfig, sim
+    from raft_tpu.multiraft.health import HealthMonitor
+    from raft_tpu.multiraft.kernels import HP_SINCE_COMMIT
+    from raft_tpu.multiraft.sim import SimConfig
+
+    with open(plan_path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    chaos_doc = None
+    if "reconfig" in doc:
+        chaos_doc = doc.get("chaos")
+        doc = doc["reconfig"]
+    plan = reconfig.plan_from_dict(doc)
+    cfg = SimConfig(
+        n_groups=groups, n_peers=plan.n_peers, collect_health=True,
+        check_quorum=check_quorum,
+    )
+    compiled = reconfig.compile_plan(plan, groups)
+    chaos_compiled = (
+        None
+        if chaos_doc is None
+        else chaos.compile_plan(chaos.plan_from_dict(chaos_doc), groups)
+    )
+    runner = reconfig.make_runner(cfg, compiled, chaos_compiled)
+
+    def fresh():
+        # Masks rebuilt per rep: the runner donates the state carry, so a
+        # shared mask buffer would be dead after the first run.
+        st = sim.init_state(cfg, *reconfig.initial_masks(plan, groups))
+        return st, sim.init_health(cfg), reconfig.init_reconfig_state(st)
+
+    st, hl, rst = fresh()
+    out = runner(st, hl, rst)  # compile + first run
+    jax.block_until_ready(out[3])
+    samples = []
+    for _ in range(reps):
+        st, hl, rst = fresh()
+        jax.block_until_ready((st, hl, rst))
+        t0 = time.perf_counter()
+        st, hl, rst, stats, rstats, safety = runner(st, hl, rst)
+        jax.block_until_ready(stats)
+        samples.append(groups * plan.n_rounds / (time.perf_counter() - t0))
+    # Reconfig-stall detection off the final rep's planes — the one
+    # shared rule (HealthMonitor.reconfig_stall_groups), same as
+    # ClusterSim.run_reconfig's.
+    stats_h, rstats_h, safety_h, om_h, since_h = jax.device_get(
+        (stats, rstats, safety, st.outgoing_mask,
+         hl.planes[HP_SINCE_COMMIT])
+    )
+    n_stuck, worst = HealthMonitor.reconfig_stall_groups(
+        om_h, since_h, cfg.election_tick
+    )
+    report = HealthMonitor.reconfig_report(
+        stats_h, rstats_h, safety_h, plan.n_rounds, n_stuck, worst,
+    )
+    report["plan"] = plan.name
+    report["groups"] = groups
+    report["peers"] = plan.n_peers
+    report["phases"] = len(plan.phases)
+    report["chaos_overlay"] = chaos_doc is not None
+    if reconfig_out:
+        with open(reconfig_out, "w") as f:
+            json.dump(report, f)
+    if any(report["safety"].values()):
+        print(
+            f"ERROR: reconfig plan {plan.name} violated safety "
+            f"invariants: {report['safety']}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return {"report": report, **rep_stats(samples)}
+
+
 def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
@@ -510,6 +607,8 @@ def main() -> None:
     ap.add_argument("--skip-anchor", action="store_true")
     ap.add_argument("--chaos", default="", metavar="PLAN_JSON")
     ap.add_argument("--chaos-out", default="", metavar="FILE")
+    ap.add_argument("--reconfig", default="", metavar="PLAN_JSON")
+    ap.add_argument("--reconfig-out", default="", metavar="FILE")
     ap.add_argument("--check", default="", metavar="BASELINE_JSON")
     ap.add_argument("--check-out", default="", metavar="FILE")
     ap.add_argument("--check-threshold", type=float, default=None)
@@ -519,12 +618,40 @@ def main() -> None:
         ap.error("--health-out requires --health")
     if args.chaos_out and not args.chaos:
         ap.error("--chaos-out requires --chaos")
+    if args.reconfig_out and not args.reconfig:
+        ap.error("--reconfig-out requires --reconfig")
+    if args.reconfig and args.chaos:
+        # A fault overlay composes INSIDE the reconfig scan — put the
+        # chaos document in the plan file ({"reconfig":..., "chaos":...}).
+        ap.error("--reconfig and --chaos are separate modes; overlay "
+                 "chaos via the reconfig plan file's \"chaos\" key")
     if (args.check_out or args.update_baseline) and not args.check:
         ap.error("--check-out/--update-baseline require --check")
     if args.lossy > 1.0 or (args.lossy < 0.0 and args.lossy != -1.0):
         # -1.0 is the chaos-off sentinel; any OTHER negative is a typo
         # that would silently bench the plain path under the steady key.
         ap.error("--lossy rate must be in [0, 1]")
+
+    if args.reconfig:
+        reconfig_stats = bench_reconfig(
+            args.reconfig, args.groups, args.reps, args.reconfig_out,
+            check_quorum=args.check_quorum,
+        )
+        warn_spread("reconfig device", reconfig_stats)
+        line = {
+            "metric": "raft_reconfig_ticks_per_sec"
+            + ("_cq" if args.check_quorum else ""),
+            "value": reconfig_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            **reconfig_stats,
+        }
+        if args.check_quorum:
+            line["check_quorum"] = True
+        print(json.dumps(line))
+        if args.check:
+            run_check(args, line)
+        return
 
     if args.chaos:
         chaos_stats = bench_chaos(

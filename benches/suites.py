@@ -176,6 +176,7 @@ def bench_baseline_configs(results, quick):
         results.append((name, G * rounds / dt / 1e6, "M ticks/s"))
 
     if not quick:
+        results.append(bench_config4_reconfig_compiled())
         results.append(bench_config4_joint_churn())
         results.append(bench_read_barrier())
         results.append(bench_fused_instrumented())
@@ -366,11 +367,68 @@ def bench_read_barrier():
     return ("read_index: 100k x 5 barrier", G * reads / dt / 1e6, "M reads/s")
 
 
+def bench_config4_reconfig_compiled():
+    """BASELINE config 4, the real protocol (ISSUE 10): 100k groups under
+    joint-consensus reconfig churn as ONE compiled scan — the conf entry
+    proposes at each group's leader, its mask swap gates on the dual-
+    majority commit, and the joint-window safety invariants fold every
+    round (raft_tpu.multiraft.reconfig), zero host round trips."""
+    import jax
+
+    from raft_tpu.multiraft import reconfig, sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    G, P = 100_000, 5
+    plan = reconfig.ReconfigPlan(
+        name="config4",
+        n_peers=P,
+        voters=[1, 2, 3],
+        phases=[
+            reconfig.ReconfigPhase(rounds=12, append=1),
+            reconfig.ReconfigPhase(
+                rounds=16, append=1,
+                op={"enter_joint": [{"add": 4}, {"add": 5}, {"remove": 1}]},
+            ),
+            reconfig.ReconfigPhase(
+                rounds=16, append=1, op={"leave_joint": True}
+            ),
+            reconfig.ReconfigPhase(
+                rounds=16, append=1, op={"add_voter": 1}
+            ),
+        ],
+    )
+    cfg = SimConfig(n_groups=G, n_peers=P, collect_health=True)
+    compiled = reconfig.compile_plan(plan, G)
+    runner = reconfig.make_runner(cfg, compiled)
+
+    def fresh():
+        st = sim.init_state(cfg, *reconfig.initial_masks(plan, G))
+        return st, sim.init_health(cfg), reconfig.init_reconfig_state(st)
+
+    out = runner(*fresh())  # compile + settle-free first run
+    jax.block_until_ready(out[3])
+    args = fresh()
+    jax.block_until_ready(args)
+    t0 = time.perf_counter()
+    st, hl, rst, stats, rstats, safety = runner(*args)
+    jax.block_until_ready(stats)
+    dt = time.perf_counter() - t0
+    assert not int(safety.sum()), "config4 run flagged safety violations"
+    return (
+        "config4: 100k x 5 compiled reconfig churn",
+        G * plan.n_rounds / dt / 1e6,
+        "M ticks/s",
+    )
+
+
 def bench_config4_joint_churn():
-    """BASELINE config 4: 100k groups under joint-consensus reconfig churn —
-    every k rounds the membership barrier swaps the voter/outgoing mask
-    planes (enter-joint / leave-joint), exercising the JointConfig commit
-    path + device mask rematerialization."""
+    """BASELINE config 4, the RETIRED pre-ISSUE-10 methodology (kept as
+    the before/after anchor for bench_config4_reconfig_compiled): every k
+    rounds a HOST-SIDE membership barrier swaps the voter/outgoing mask
+    planes (enter-joint / leave-joint) around a donated device scan —
+    exercising the JointConfig commit path but paying a host round trip
+    and mask re-upload per swap, with no conf-entry protocol and no
+    joint-window safety audit."""
     import functools
 
     import jax

@@ -519,6 +519,11 @@ class Metrics:
             "Groups per commit-lag bucket (lower bound label, ticks)",
             ("ge",),
         )
+        self.health_reconfig_stalled = r.gauge(
+            "health_groups_reconfig_stalled",
+            "Groups sitting in a joint config with a stalled commit "
+            "(HealthMonitor.record_reconfig's stall detection)",
+        )
 
     # --- tracing ---
 

@@ -34,10 +34,16 @@ from .sim import (
     init_health,
     read_index,
 )
-from .simref import ChaosOracle, HealthOracle, ScalarCluster
+from .simref import (
+    ChaosOracle,
+    HealthOracle,
+    ReconfigOracle,
+    ScalarCluster,
+)
 
 __all__ = [
     "ChaosOracle",
+    "ReconfigOracle",
     "committed_index",
     "committed_index_grouped",
     "joint_committed_index",
@@ -54,6 +60,7 @@ __all__ = [
     "read_index",
     # submodules imported lazily to keep jax-light paths cheap:
     #   .chaos     fault-plan compiler + compiled-schedule runner
+    #   .reconfig  membership-churn plan compiler + compiled-schedule runner
     #   .driver    MultiRaft host driver
     #   .native    NativeMultiRaft C++ engine bindings
     #   .pallas_step  fused steady-round kernels

@@ -79,6 +79,63 @@ def load_state(path: str) -> SimState:
     return SimState(**fields)
 
 
+_RECONFIG_FORMAT_VERSION = 1
+
+
+def save_reconfig_state(rstate, path: str) -> None:
+    """Atomically write a reconfig.ReconfigState (the in-flight conf-op
+    carry: stage/op_ptr/pending-entry cursors + the previous round's mask
+    planes) alongside a SimState checkpoint, so a membership-churn run
+    resumes mid-plan bit-identically (the schedule arrays themselves are
+    recompiled from the plan — only the mutable carry needs persisting)."""
+    from .reconfig import ReconfigState
+
+    arrays = {
+        name: np.asarray(getattr(rstate, name))
+        for name in ReconfigState._fields
+    }
+    arrays["__reconfig_version__"] = np.asarray(_RECONFIG_FORMAT_VERSION)
+    dir_ = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_reconfig_state(path: str):
+    """Load a reconfig carry written by save_reconfig_state."""
+    from .reconfig import ReconfigState
+
+    with np.load(path) as data:
+        if "__reconfig_version__" not in data:
+            raise ValueError(
+                f"{path!r} is not a reconfig-state checkpoint (missing "
+                "version marker — did you pass a SimState checkpoint?)"
+            )
+        version = int(data["__reconfig_version__"])
+        if version != _RECONFIG_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported reconfig checkpoint version {version}"
+            )
+        fields = {}
+        for name in ReconfigState._fields:
+            if name not in data:
+                raise ValueError(
+                    f"reconfig checkpoint {path!r} is missing plane "
+                    f"{name!r} (corrupt or truncated file)"
+                )
+            arr = data[name]
+            fields[name] = jnp.asarray(arr, dtype=arr.dtype)
+    return ReconfigState(**fields)
+
+
 def hard_states(state: SimState) -> Dict[str, np.ndarray]:
     """The durable per-peer raft state {term, vote, commit} (reference:
     proto/proto/eraftpb.proto:94-98), shaped [P, G]."""

@@ -154,6 +154,114 @@ class HealthMonitor:
             },
         }
 
+    @staticmethod
+    def reconfig_stall_groups(
+        outgoing_mask, since_commit, election_tick: int,
+        stall_timeouts: int = 4, topk: int = 8,
+    ):
+        """THE reconfig-stall rule, host-side off downloaded planes: a
+        group still inside a joint config (outgoing half non-empty)
+        whose commit has been flat for `stall_timeouts * election_tick`
+        rounds — the existing commit-stall health plane joined with the
+        joint bit, no new device plane.  Shared by
+        ClusterSim.run_reconfig and bench.py --reconfig so the threshold
+        and ranking cannot drift between the two surfaces.  Returns
+        (stalled_count, worst_group_ids) with worst ranked by staleness,
+        capped at `topk`."""
+        import numpy as np
+
+        # graftcheck: allow-no-host-sync-in-jit — callers pass planes
+        # they already downloaded (device_get) at end of run; this whole
+        # helper is deliberately host-side.
+        joint = np.any(np.asarray(outgoing_mask), axis=0)
+        # graftcheck: allow-no-host-sync-in-jit — same (host-side rule).
+        since = np.asarray(since_commit)
+        stuck = joint & (since >= stall_timeouts * election_tick)
+        n_stuck = int(stuck.sum())
+        order = np.argsort(np.where(stuck, since, -1))[::-1]
+        return n_stuck, [int(g) for g in order[: min(n_stuck, topk)]]
+
+    @staticmethod
+    def reconfig_report(
+        stats, rstats, safety, rounds: int, stalled_groups: int,
+        stalled_worst=(),
+    ) -> dict:
+        """Per-scenario reconfig summary off the device accumulators.
+
+        stats:   [chaos.N_CHAOS_STATS] int32 MTTR facts (same fold as the
+                 chaos runner — reconfig churn rides the leaderless plane
+                 too).
+        rstats:  [reconfig.N_RECONFIG_STATS] int32 op-protocol counts
+                 (RC_* indices: proposals / applies / retries /
+                 joint-group-rounds).
+        safety:  [kernels.N_SAFETY] int32 violation counts, now including
+                 the joint-window slots; all-zero on every correct run.
+        rounds:  rounds executed.
+        stalled_groups / stalled_worst: the host-side stall detection —
+                 groups sitting in a joint config (outgoing half
+                 non-empty) whose commit has stalled past the threshold,
+                 derived from the existing commit-stall health plane plus
+                 the joint bit (no new device plane).
+
+        Returns the scenario-summary dict bench.py --reconfig and
+        tools/reconfig_report.py emit as CI artifacts.
+        """
+        from .chaos import CS_MAX_STREAK, CS_REELECTIONS, CS_HEALED_ROUNDS
+        from .kernels import SAFETY_NAMES
+        from .reconfig import RECONFIG_STAT_NAMES
+
+        reelections = int(stats[CS_REELECTIONS])
+        healed = int(stats[CS_HEALED_ROUNDS])
+        return {
+            "rounds": int(rounds),
+            **{
+                name: int(v)
+                for name, v in zip(RECONFIG_STAT_NAMES, rstats)
+            },
+            "mttr_rounds": (
+                round(healed / reelections, 3) if reelections else None
+            ),
+            "reelections": reelections,
+            "max_leaderless_streak": int(stats[CS_MAX_STREAK]),
+            "reconfig_stalled_groups": int(stalled_groups),
+            "reconfig_stalled_worst": [int(g) for g in stalled_worst],
+            "safety": {
+                name: int(v) for name, v in zip(SAFETY_NAMES, safety)
+            },
+        }
+
+    def record_reconfig(self, report: dict) -> dict:
+        """Fold a reconfig scenario report (reconfig_report's shape) into
+        the flight recorder, gauges, and trace stream; stalled groups
+        raise a `health.reconfig_stall` event and safety violations a
+        `reconfig.safety` event so neither can scroll by silently."""
+        with self._lock:
+            entry = {"seq": self._seq, "ts": time.time(),
+                     "reconfig": report}
+            self._seq += 1
+            self._ring.append(entry)
+        m = self.metrics
+        if m is not None:
+            stalled = report.get("reconfig_stalled_groups", 0)
+            m.health_reconfig_stalled.set(stalled)
+            m.trace(
+                "reconfig.scenario",
+                rounds=report.get("rounds", 0),
+                proposals=report.get("proposals", 0),
+                ops_applied=report.get("ops_applied", 0),
+                retries=report.get("retries", 0),
+                joint_group_rounds=report.get("joint_group_rounds", 0),
+            )
+            if stalled:
+                m.trace(
+                    "health.reconfig_stall",
+                    stalled=stalled,
+                    worst=report.get("reconfig_stalled_worst", []),
+                )
+            if any(report.get("safety", {}).values()):
+                m.trace("reconfig.safety", **report["safety"])
+        return entry
+
     def record_scenario(self, report: dict) -> dict:
         """Fold a chaos scenario report (chaos_report's shape) into the
         flight recorder and trace stream; safety violations raise a

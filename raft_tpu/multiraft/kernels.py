@@ -73,7 +73,19 @@ here is missing from it or untested under tests/.
   check_safety             <-> the Raft safety arguments themselves
                                (tests/test_chaos_parity.py drives it every
                                fuzz round; ChaosOracle holds the scalar
-                               state it must never flag)
+                               state it must never flag; the joint-window
+                               slots run every reconfig round against
+                               simref.ReconfigOracle state in
+                               tests/test_reconfig_parity.py)
+  apply_confchange         <-> confchange.Changer transitions + raft.rs
+                               post_conf_change reactions
+                               (reference: changer.rs:40-280,
+                               raft.rs:2604-2673); targets are
+                               Changer-validated host-side by
+                               reconfig.compile_plan, and
+                               simref.ReconfigOracle performs the
+                               bit-identical scalar surgery —
+                               tests/test_reconfig_parity.py
   check_quorum_active      <-> tracker.ProgressTracker.quorum_recently_active
                                (reference: tracker.rs:346-372); the damped
                                round reads it at each leader's
@@ -91,7 +103,7 @@ overflow), so no x64 dependency.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -400,13 +412,22 @@ SV_DUAL_LEADER = 0  # two leaders share a term in one group
 SV_COMMIT_DIVERGED = 1  # two peers' committed prefixes disagree
 SV_COMMIT_REGRESSED = 2  # some peer's commit index decreased
 SV_CURSOR_INVALID = 3  # agree/commit cursors exceed log bounds
-N_SAFETY = 4
+# Joint-window invariants (ISSUE 10): checked only when the optional mask
+# args are given; the slots stay zero otherwise so every accumulator keeps
+# one uniform [N_SAFETY] shape.
+SV_LEADER_NOT_IN_CONFIG = 4  # a non-follower outside voter|outgoing
+SV_COMMIT_NO_QUORUM = 5  # a commit advance lacking either joint majority
+SV_CONF_DOUBLE_CHANGE = 6  # an illegal single-step membership transition
+N_SAFETY = 7
 
 SAFETY_NAMES = (
     "dual_leader",
     "commit_diverged",
     "commit_regressed",
     "cursor_invalid",
+    "leader_not_in_config",
+    "commit_no_quorum",
+    "conf_double_change",
 )
 
 
@@ -417,6 +438,12 @@ def check_safety(
     last_index: jnp.ndarray,  # gc: int32[P, G]
     agree: jnp.ndarray,  # gc: int32[P, P, G]
     prev_commit: jnp.ndarray,  # gc: int32[P, G]
+    voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    matched: Optional[jnp.ndarray] = None,  # gc: int32[P, P, G]
+    crashed: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    prev_voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    prev_outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
 ) -> jnp.ndarray:
     """Device-side Raft safety invariants over one round boundary.
 
@@ -432,8 +459,33 @@ def check_safety(
       * cursor sanity: commit <= last_index and
         agree[a, b] <= min(last_a, last_b).
 
-    The chaos fuzz harness folds these counts into the compiled schedule
-    scan every round and asserts the run total is zero.
+    Joint-window invariants (the historical reconfig-bug territory; active
+    only when `voter_mask`/`outgoing_mask`/`matched` are given, so legacy
+    callers keep their graphs — the extra slots just stay zero):
+
+      * election safety under dual majorities: any peer acting above
+        follower must sit in at least one half of the (possibly joint)
+        config — a demoted leader/candidate that failed to step down is
+        exactly how a removed node keeps committing
+        (SV_LEADER_NOT_IN_CONFIG; the per-term dual-leader check above
+        already covers the joint window since joint elections still
+        produce at most one winner per term);
+      * no commit that lacks either majority: a leader's commit may only
+        advance past the round's starting high-water mark when its OWN
+        tracker rows reach that index under BOTH majorities
+        (quorum/joint.rs min-of-halves, SV_COMMIT_NO_QUORUM).  Stale
+        lower-term alive leaders are exempt: the commit-propagation
+        approximation lets them LEARN a settled commit without deposing
+        them, which is learning, not committing (`crashed` marks the
+        peers whose isolation makes the exemption unnecessary);
+      * no single-step double-membership change (SV_CONF_DOUBLE_CHANGE,
+        needs `prev_voter_mask`/`prev_outgoing_mask`): outside joint at
+        most one voter may change per transition; entering joint must set
+        outgoing to exactly the old incoming; leaving must clear outgoing
+        with incoming untouched; while joint the masks must not move.
+
+    The chaos/reconfig fuzz harnesses fold these counts into the compiled
+    schedule scan every round and assert the run total is zero.
     """
     P = state.shape[0]
     off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
@@ -449,6 +501,78 @@ def check_safety(
     regressed = commit < prev_commit
     lmin = jnp.minimum(last_index[:, None, :], last_index[None, :, :])
     invalid = ((agree > lmin) & off_diag) | (commit > last_index)[:, None, :]
+    zero = jnp.int32(0)
+    if voter_mask is not None:
+        if outgoing_mask is None or matched is None:
+            raise ValueError(
+                "joint-window checks need voter_mask, outgoing_mask AND "
+                "matched together"
+            )
+        non_follower = state != ROLE_FOLLOWER
+        outside = non_follower & ~(voter_mask | outgoing_mask)
+        # dtype= on the counts: bare bool sums widen to int64 under x64
+        # (GC007) and these feed an int32 scan accumulator.
+        sv_outside = jnp.sum(jnp.any(outside, axis=0), dtype=jnp.int32)
+        alive = (
+            ~crashed if crashed is not None else jnp.ones_like(is_lead)
+        )
+        # Checked set: every crashed leader (isolation means it cannot
+        # learn, so its commit is its own quorum's work) plus the
+        # max-term alive leaders (a stale lower-term alive leader can
+        # LEARN a settled commit via the propagation approximation).
+        lead_alive = is_lead & alive
+        max_alive_term = jnp.max(jnp.where(lead_alive, term, -1), axis=0)
+        checked = is_lead & (~alive | (term == max_alive_term[None, :]))
+        # Per-owner joint commit bound off each leader's own tracker row
+        # (reference: joint.rs:47-51 min over both majorities).
+        owner_rows = jnp.swapaxes(matched, 1, 2)  # [P_owner, G, P_target]
+        mci = jnp.minimum(
+            committed_index(
+                owner_rows,
+                jnp.broadcast_to(
+                    jnp.swapaxes(voter_mask, 0, 1)[None, :, :],
+                    owner_rows.shape,
+                ),
+            ),
+            committed_index(
+                owner_rows,
+                jnp.broadcast_to(
+                    jnp.swapaxes(outgoing_mask, 0, 1)[None, :, :],
+                    owner_rows.shape,
+                ),
+            ),
+        )  # [P_owner, G]
+        prev_high = jnp.max(prev_commit, axis=0)  # [G]
+        unbacked = (
+            checked & (commit > prev_high[None, :]) & (commit > mci)
+        )
+        sv_unbacked = jnp.sum(jnp.any(unbacked, axis=0), dtype=jnp.int32)
+    else:
+        sv_outside = zero
+        sv_unbacked = zero
+    if prev_voter_mask is not None:
+        if voter_mask is None or prev_outgoing_mask is None:
+            raise ValueError(
+                "the double-change check needs prev AND current masks"
+            )
+        was_j = jnp.any(prev_outgoing_mask, axis=0)
+        now_j = jnp.any(outgoing_mask, axis=0)
+        vm_delta = jnp.sum(
+            prev_voter_mask ^ voter_mask, axis=0, dtype=jnp.int32
+        )
+        om_moved = jnp.any(prev_outgoing_mask ^ outgoing_mask, axis=0)
+        enter_bad = (~was_j & now_j) & jnp.any(
+            outgoing_mask ^ prev_voter_mask, axis=0
+        )
+        leave_bad = (was_j & ~now_j) & (vm_delta > 0)
+        stay_bad = (was_j & now_j) & ((vm_delta > 0) | om_moved)
+        simple_bad = (~was_j & ~now_j) & (vm_delta > 1)
+        sv_double = jnp.sum(
+            enter_bad | leave_bad | stay_bad | simple_bad,
+            dtype=jnp.int32,
+        )
+    else:
+        sv_double = zero
     # dtype= on the group counts: a bare bool sum widens to int64 under x64
     # (GC007), and these feed an int32 scan accumulator.
     return jnp.stack(
@@ -457,8 +581,114 @@ def check_safety(
             jnp.sum(jnp.any(diverged, axis=(0, 1)), dtype=jnp.int32),
             jnp.sum(jnp.any(regressed, axis=0), dtype=jnp.int32),
             jnp.sum(jnp.any(invalid, axis=(0, 1)), dtype=jnp.int32),
+            sv_outside,
+            sv_unbacked,
+            sv_double,
         ]
     )
+
+
+def apply_confchange(
+    state: jnp.ndarray,  # gc: int32[P, G]
+    leader_id: jnp.ndarray,  # gc: int32[P, G]
+    commit: jnp.ndarray,  # gc: int32[P, G]
+    term_start_index: jnp.ndarray,  # gc: int32[P, G]
+    matched: jnp.ndarray,  # gc: int32[P, P, G]
+    voter_mask: jnp.ndarray,  # gc: bool[P, G]
+    outgoing_mask: jnp.ndarray,  # gc: bool[P, G]
+    learner_mask: jnp.ndarray,  # gc: bool[P, G]
+    new_voter: jnp.ndarray,  # gc: bool[P, G]
+    new_outgoing: jnp.ndarray,  # gc: bool[P, G]
+    new_learner: jnp.ndarray,  # gc: bool[P, G]
+    added: jnp.ndarray,  # gc: bool[P, G]
+    removed: jnp.ndarray,  # gc: bool[P, G]
+    apply_mask: jnp.ndarray,  # gc: bool[G]
+    recent_active: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
+) -> Tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+    jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray],
+]:
+    """Commit one validated conf change per selected group: swap the
+    config mask planes and run the reference's apply-time reactions
+    (reference: confchange/changer.rs for the transition shapes —
+    validated host-side by `reconfig.compile_plan` driving the scalar
+    `confchange.Changer` — and raft.rs:2604-2673 `post_conf_change` for
+    the reactions).
+
+    new_voter/new_outgoing/new_learner are the PRE-VALIDATED target masks
+    of the op being applied (joint-entry targets carry outgoing = the old
+    incoming config; joint-exit targets carry outgoing all-False with
+    staged learners_next materialized).  `added`/`removed` are the member
+    deltas (member = voter|outgoing|learner): like the reference's
+    progress-map changes, an added member gets a FRESH tracker row —
+    matched zeroed across every owner, recent_active granted (the
+    added-node grace of Changer's Progress::new) — and a removed member's
+    rows are cleared so a later re-add starts fresh.
+
+    Apply-time reactions, exactly mirrored by `simref.ReconfigOracle`'s
+    scalar surgery:
+
+      * leader-step-down when the leader leaves the config: any peer
+        acting above follower that lands outside voter|outgoing becomes a
+        follower with leader_id cleared (the ISSUE rule; the reference's
+        post_conf_change early-returns for a removed leader);
+      * quorum-shrink commit pickup (post_conf_change's maybe_commit): a
+        surviving leader re-evaluates its joint commit bound under the
+        NEW masks — a joint-exit can commit entries that lacked the
+        outgoing majority — still gated on the leader's own term
+        (term_start_index, raft_log.maybe_commit's check).  No broadcast
+        happens here: the round's ordinary traffic propagates it.
+
+    Returns (state', leader_id', commit', matched', voter', outgoing',
+    learner', recent_active'); recent_active passes through as None for
+    undamped states so the undamped pytree is unchanged.
+    """
+    ap = apply_mask[None, :]  # [1, G]
+    vm = jnp.where(ap, new_voter, voter_mask)
+    om = jnp.where(ap, new_outgoing, outgoing_mask)
+    lm = jnp.where(ap, new_learner, learner_mask)
+    delta_t = (added | removed)[None, :, :]  # target axis
+    matched2 = jnp.where(apply_mask[None, None, :] & delta_t, 0, matched)
+    if recent_active is not None:
+        ra = jnp.where(
+            apply_mask[None, None, :] & added[None, :, :],
+            True,
+            jnp.where(
+                apply_mask[None, None, :] & removed[None, :, :],
+                False,
+                recent_active,
+            ),
+        )
+    else:
+        ra = None
+    step_down = ap & (state != ROLE_FOLLOWER) & ~(vm | om)
+    state2 = jnp.where(step_down, ROLE_FOLLOWER, state)
+    leader2 = jnp.where(step_down, 0, leader_id)
+    # Quorum-shrink pickup off each surviving leader's own tracker rows
+    # (joint.rs:47-51 min over both majorities under the NEW masks).
+    owner_rows = jnp.swapaxes(matched2, 1, 2)  # [P_owner, G, P_target]
+    mci = jnp.minimum(
+        committed_index(
+            owner_rows,
+            jnp.broadcast_to(
+                jnp.swapaxes(vm, 0, 1)[None, :, :], owner_rows.shape
+            ),
+        ),
+        committed_index(
+            owner_rows,
+            jnp.broadcast_to(
+                jnp.swapaxes(om, 0, 1)[None, :, :], owner_rows.shape
+            ),
+        ),
+    )  # [P_owner, G]
+    pickup = (
+        ap
+        & (state2 == ROLE_LEADER)
+        & (mci >= term_start_index)
+        & (mci < INF)
+    )
+    commit2 = jnp.where(pickup, jnp.maximum(commit, mci), commit)
+    return state2, leader2, commit2, matched2, vm, om, lm, ra
 
 
 def check_quorum_active(

@@ -1357,10 +1357,20 @@ def steady_mask(
     crashed: jnp.ndarray,
     horizon: int = 1,
     link: Optional[jnp.ndarray] = None,
+    reconfig_pending: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """bool[G]: per-group steady invariant for the next `horizon` rounds —
     no election timer can fire, exactly one alive leader, every alive peer
     already at the leader's term, not in joint config.
+
+    `reconfig_pending` (optional bool[G] — reconfig.pending_in_horizon:
+    groups with a conf entry in flight OR a scheduled op becoming eligible
+    within the horizon) is a hard rejection: the fused kernel can neither
+    append the conf entry, evaluate the dual-majority commit gate, nor
+    swap the mask planes mid-horizon, so any horizon containing a
+    scheduled reconfig must take the general path (ISSUE 10; the joint
+    window itself is already rejected by the not-joint condition below).
+    None keeps every existing graph unchanged.
 
     With `link` (the chaos engine's bool[P, P, G] reachability plane) the
     invariant additionally requires every directed link among alive peers
@@ -1425,6 +1435,9 @@ def steady_mask(
     # joint groups take the general XLA path)
     not_joint = ~jnp.any(st.outgoing_mask, axis=0)
     ok = no_campaign & one_leader & terms_ok & not_joint
+    if reconfig_pending is not None:
+        # 4b. no scheduled reconfig touches the horizon (see docstring).
+        ok = ok & ~reconfig_pending
     if link is not None:
         # 5. every directed link among alive peers is up (crashed peers'
         # links and self-links are dead weight either way).

@@ -1,0 +1,828 @@
+"""Device-resident membership churn: declarative joint-consensus reconfig
+plans compiled into on-device schedules for the batched sim (BASELINE
+config 4; ROADMAP item 4 — compile reconfig the way chaos.py compiles
+fault schedules).
+
+A :class:`ReconfigPlan` is a list of phases; a phase may carry ONE
+conf-change op (add/remove voter, add/promote learner, explicit
+joint-entry/joint-exit) that is ENQUEUED for the selected groups at the
+phase's first round.  :func:`compile_plan` lowers the plan host-side by
+driving the scalar ``confchange.Changer`` — every transition is validated
+and its target masks computed by the reference's own rules (one voter per
+simple step, outgoing := old incoming on joint-entry, ``learners_next``
+staging, materialized on leave) — into dense per-op schedule arrays;
+:func:`make_runner` then executes the whole multi-phase scenario inside
+ONE jitted ``lax.scan`` with zero host round trips, composable with a
+compiled :class:`chaos.ChaosPlan` of equal length in the SAME scan
+(reconfig *during* partition/loss/crash — the Jepsen-style killer
+scenario).
+
+The in-scan op protocol per group (the scalar twin is
+``simref.ReconfigOracle``, which replays the identical rules through real
+Raft state machines and applies the identical surgery — exact per-round
+state+health parity in tests/test_reconfig_parity.py):
+
+  propose   an eligible op (its phase reached, all earlier ops applied)
+            appends one conf entry at the group's acting leader — the
+            step reports where it landed (sim.ReconfigProposal: owner,
+            index, term); no alive leader -> retry next round;
+  wait      the swap is GATED on the entry committing under BOTH
+            majorities of the (possibly joint) config: commit itself
+            requires the dual quorum (quorum/joint.rs min-of-halves), so
+            the gate is `owner still leader at its propose term (and not
+            crashed) AND owner.commit >= entry index`;
+  retry     a deposed/crashed owner invalidates the pending entry (it may
+            be overwritten, and a frozen owner can never advance) — the
+            op re-proposes at the next acting leader, exactly like an
+            operator re-submitting a conf change that fell into a
+            leadership change;
+  apply     ``kernels.apply_confchange`` swaps the
+            voter/outgoing/learner mask planes at the round boundary for
+            every peer of the group at once and runs the reference's
+            apply-time reactions (leader-step-down when the leader leaves
+            the config, fresh tracker rows for added members,
+            quorum-shrink commit pickup) — raft.rs post_conf_change
+            semantics on the batched planes.
+
+Every scan round also folds ``kernels.check_safety`` WITH the
+joint-window invariants (election safety under dual majorities, no
+commit lacking either majority, no single-step double-membership change
+— the masks-transition pair is checked one round later, with a tail
+check after the scan covering the final apply) into a violation
+accumulator, plus the chaos MTTR stats and a reconfig stats vector
+(proposals/applies/retries/joint-group-rounds).
+
+Plan JSON (see docs/OBSERVABILITY.md "Reconfig" and
+tests/testdata/reconfig/)::
+
+    {"name": "joint-churn", "peers": 5, "voters": [1, 2, 3],
+     "learners": [4],
+     "phases": [
+        {"rounds": 30},                                     # settle
+        {"rounds": 40, "op": {"enter_joint": [{"add": 5}, {"remove": 1}]},
+         "groups": {"mod": 2, "eq": 0}, "append": 1},
+        {"rounds": 20, "op": {"leave_joint": true}},
+        {"rounds": 10, "op": {"promote_learner": 4}}]}
+
+Op forms: ``{"add_voter": p}``, ``{"remove_voter": p}``,
+``{"add_learner": p}``, ``{"promote_learner": p}`` (single-step simple
+changes), ``{"enter_joint": [{"add": p} | {"remove": p} | {"learner": p},
+...]}`` and ``{"leave_joint": true}`` (explicit joint window).  Ops queue
+strictly in phase order per group; an op whose phase arrives while an
+earlier op is still pending waits its turn.
+
+Schedule arrays stay small (ops-per-group x [P, G] masks, not
+per-round), and the stats accumulators count at most one event per
+(group, round): ``compile_plan`` asserts rounds x groups < 2**31 so the
+int32 accumulators provably cannot wrap (the GC008 discipline,
+docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import chaos as chaos_mod
+from . import kernels
+from . import sim as sim_mod
+from ..confchange import Changer
+from ..confchange.changer import MapChangeType
+from ..eraftpb import ConfChangeSingle, ConfChangeType
+from ..tracker import ProgressTracker
+
+# Padding sentinel for op_start: far beyond any legal plan (compile_plan
+# bounds rounds x groups < 2**31, so rounds < 2**30 whenever G >= 2).
+NO_ROUND = 1 << 30
+
+_SIMPLE_OPS = ("add_voter", "remove_voter", "add_learner", "promote_learner")
+
+
+@dataclass
+class ReconfigPhase:
+    """One contiguous stretch of rounds, optionally enqueuing ONE op.
+
+    rounds: phase length in protocol rounds (>= 1).
+    op:     the op document ({"add_voter": p}, {"enter_joint": [...]},
+            {"leave_joint": true}, ...) enqueued for the selected groups
+            at the phase's FIRST round; None = settle/wait phase.
+    groups: which groups the op applies to (chaos.py group selectors);
+            non-selected groups skip this op entirely.
+    append: per-round append workload proposed at each group's leader
+            for the phase (all groups — the background write load the
+            reconfig must ride along with).
+    """
+
+    rounds: int
+    op: Optional[Dict[str, object]] = None
+    groups: chaos_mod.GroupSel = "all"
+    append: int = 0
+
+
+@dataclass
+class ReconfigPlan:
+    """A named multi-phase membership-churn scenario (host-side,
+    declarative).  `voters`/`learners` (1-based peer ids) are the
+    bootstrap configuration of every group — they must match the sim
+    state the runner is applied to (use :func:`initial_masks`)."""
+
+    name: str
+    n_peers: int
+    phases: List[ReconfigPhase]
+    voters: List[int] = field(default_factory=list)
+    learners: List[int] = field(default_factory=list)
+
+    @property
+    def n_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+
+def plan_from_dict(doc: Dict[str, object]) -> ReconfigPlan:
+    """Build a ReconfigPlan from its JSON document form (module doc)."""
+    n_peers = int(doc["peers"])  # type: ignore[arg-type]
+    phases: List[ReconfigPhase] = []
+    for ph in doc["phases"]:  # type: ignore[index]
+        if not isinstance(ph, dict):
+            raise ValueError(f"phase is not an object: {ph!r}")
+        phases.append(
+            ReconfigPhase(
+                rounds=int(ph["rounds"]),  # type: ignore[arg-type]
+                op=ph.get("op"),  # type: ignore[arg-type]
+                groups=ph.get("groups", "all"),  # type: ignore[arg-type]
+                append=int(ph.get("append", 0)),  # type: ignore[arg-type]
+            )
+        )
+    voters = [int(p) for p in doc.get("voters", [])]  # type: ignore[union-attr]
+    return ReconfigPlan(
+        name=str(doc.get("name", "unnamed")),
+        n_peers=n_peers,
+        phases=phases,
+        voters=voters or list(range(1, n_peers + 1)),
+        learners=[int(p) for p in doc.get("learners", [])],  # type: ignore[union-attr]
+    )
+
+
+def load_plan(path: str) -> ReconfigPlan:
+    """Load a ReconfigPlan from a JSON file (bench.py --reconfig)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return plan_from_dict(json.load(f))
+
+
+# --- host-side compilation: drive the scalar confchange path ---------------
+
+
+class _OpSlot(NamedTuple):
+    """One validated transition of one group chain: the Changer-computed
+    target configuration (as plain sets), the progress-map delta, and the
+    member delta the device kernel applies."""
+
+    voters_inc: frozenset
+    voters_out: frozenset
+    learners: frozenset
+    learners_next: frozenset
+    changes: Tuple[Tuple[int, int], ...]  # (peer id, MapChangeType value)
+    added: frozenset  # fresh members (fresh tracker rows + ra grace)
+    removed: frozenset  # ex-members (tracker rows cleared)
+    phase: int  # the enqueuing phase index (start-round lookup)
+
+
+def _peer(pid: object, n_peers: int, what: str, phase: int) -> int:
+    p = int(pid)  # type: ignore[call-overload]
+    if not 1 <= p <= n_peers:
+        raise ValueError(
+            f"phase {phase}: {what} peer id {p} out of range [1, {n_peers}]"
+        )
+    return p
+
+
+def _op_ccs(
+    op: Dict[str, object], n_peers: int, phase: int
+) -> Tuple[str, List[ConfChangeSingle]]:
+    """Normalize one op document -> (kind, ConfChangeSingle list)."""
+    kinds = [k for k in op if k in _SIMPLE_OPS + ("enter_joint", "leave_joint")]
+    if len(kinds) != 1 or len(op) != 1:
+        raise ValueError(
+            f"phase {phase}: op must have exactly one kind, got {op!r}"
+        )
+    kind = kinds[0]
+    V, L, R = (
+        ConfChangeType.AddNode,
+        ConfChangeType.AddLearnerNode,
+        ConfChangeType.RemoveNode,
+    )
+    if kind == "leave_joint":
+        # {"leave_joint": false} would otherwise still leave (the value
+        # was never read) — an edited-to-disable plan must fail loudly;
+        # delete the op to make a phase a settle phase.
+        if not op[kind]:
+            raise ValueError(
+                f"phase {phase}: leave_joint must be true — remove the "
+                "op to disable the phase"
+            )
+        return kind, []
+    if kind == "enter_joint":
+        ccs = []
+        for ch in op[kind]:  # type: ignore[attr-defined]
+            if not isinstance(ch, dict) or len(ch) != 1:
+                raise ValueError(
+                    f"phase {phase}: enter_joint change must be one of "
+                    f'{{"add"|"remove"|"learner": peer}}, got {ch!r}'
+                )
+            (what, pid), = ch.items()
+            p = _peer(pid, n_peers, f"enter_joint {what}", phase)
+            t = {"add": V, "remove": R, "learner": L}.get(what)
+            if t is None:
+                raise ValueError(
+                    f"phase {phase}: unknown enter_joint change {what!r}"
+                )
+            ccs.append(ConfChangeSingle(t, p))
+        if not ccs:
+            raise ValueError(f"phase {phase}: enter_joint with no changes")
+        return kind, ccs
+    p = _peer(op[kind], n_peers, kind, phase)
+    t = {"add_voter": V, "promote_learner": V, "add_learner": L,
+         "remove_voter": R}[kind]
+    return kind, [ConfChangeSingle(t, p)]
+
+
+def _bootstrap_tracker(plan: ReconfigPlan) -> ProgressTracker:
+    t = ProgressTracker(1 << 20)
+    for v in plan.voters:
+        _peer(v, plan.n_peers, "initial voter", -1)
+        cfg, changes = Changer(t).simple(
+            [ConfChangeSingle(ConfChangeType.AddNode, int(v))]
+        )
+        t.apply_conf(cfg, changes, 1)
+    for l in plan.learners:
+        _peer(l, plan.n_peers, "initial learner", -1)
+        cfg, changes = Changer(t).simple(
+            [ConfChangeSingle(ConfChangeType.AddLearnerNode, int(l))]
+        )
+        t.apply_conf(cfg, changes, 1)
+    return t
+
+
+def _member(t: ProgressTracker) -> frozenset:
+    c = t.conf
+    return frozenset(
+        c.voters.incoming.ids() | c.voters.outgoing.ids() | c.learners
+    )
+
+
+def _walk_chain(
+    plan: ReconfigPlan, sig: Tuple[int, ...]
+) -> List[_OpSlot]:
+    """Apply the op sequence `sig` (phase indices) through the scalar
+    Changer, recording each validated transition."""
+    t = _bootstrap_tracker(plan)
+    slots: List[_OpSlot] = []
+    for phase_idx in sig:
+        op = plan.phases[phase_idx].op
+        assert op is not None
+        kind, ccs = _op_ccs(op, plan.n_peers, phase_idx)
+        # Plan-typo guards beyond the Changer's own invariants: a no-op
+        # simple change (adding an existing voter, promoting a non-
+        # learner, removing a non-voter) would propose+commit an entry
+        # that changes nothing — almost certainly a plan mistake.
+        inc = t.conf.voters.incoming.ids()
+        if kind == "add_voter" and ccs[0].node_id in inc:
+            raise ValueError(
+                f"phase {phase_idx}: add_voter {ccs[0].node_id} is "
+                "already a voter"
+            )
+        if kind == "promote_learner" and ccs[0].node_id not in t.conf.learners:
+            raise ValueError(
+                f"phase {phase_idx}: promote_learner {ccs[0].node_id} is "
+                "not currently a learner"
+            )
+        if kind == "remove_voter" and ccs[0].node_id not in inc:
+            raise ValueError(
+                f"phase {phase_idx}: remove_voter {ccs[0].node_id} is "
+                "not currently a voter"
+            )
+        if kind == "add_learner" and ccs[0].node_id in t.conf.learners:
+            raise ValueError(
+                f"phase {phase_idx}: add_learner {ccs[0].node_id} is "
+                "already a learner"
+            )
+        old_member = _member(t)
+        ch = Changer(t)
+        if kind == "enter_joint":
+            cfg, changes = ch.enter_joint(False, ccs)
+        elif kind == "leave_joint":
+            cfg, changes = ch.leave_joint()
+        else:
+            cfg, changes = ch.simple(ccs)
+        t.apply_conf(cfg, changes, 1)
+        new_member = _member(t)
+        slots.append(
+            _OpSlot(
+                voters_inc=frozenset(cfg.voters.incoming.ids()),
+                voters_out=frozenset(cfg.voters.outgoing.ids()),
+                learners=frozenset(cfg.learners),
+                learners_next=frozenset(cfg.learners_next),
+                changes=tuple((int(i), int(ct)) for i, ct in changes),
+                added=new_member - old_member,
+                removed=old_member - new_member,
+                phase=phase_idx,
+            )
+        )
+    return slots
+
+
+def _compile_schedule(plan: ReconfigPlan, n_groups: int):
+    """The shared numpy schedule (device compile AND the oracle's host
+    twin): phase timing, per-group op chains (Changer-validated), and the
+    dense per-slot target masks."""
+    P, G = plan.n_peers, n_groups
+    nph = len(plan.phases)
+    if nph == 0:
+        raise ValueError("plan has no phases")
+    if plan.n_rounds * max(1, G) >= 2**31:
+        raise ValueError(
+            f"plan spans {plan.n_rounds} rounds x {G} groups >= 2**31 "
+            "(group, round) pairs; the int32 reconfig/safety accumulators "
+            "could wrap — split the plan"
+        )
+    phase_of_round = np.zeros(plan.n_rounds, dtype=np.int32)
+    phase_start = np.zeros(nph, dtype=np.int32)
+    append = np.zeros((nph, G), dtype=np.int32)
+    r0 = 0
+    op_phases: List[int] = []
+    gsel_by_phase: Dict[int, np.ndarray] = {}
+    for i, ph in enumerate(plan.phases):
+        if ph.rounds < 1:
+            raise ValueError(f"phase {i}: rounds must be >= 1")
+        phase_of_round[r0 : r0 + ph.rounds] = i
+        phase_start[i] = r0
+        r0 += ph.rounds
+        append[i] = ph.append
+        if ph.op is not None:
+            op_phases.append(i)
+            gsel_by_phase[i] = chaos_mod._group_mask(ph.groups, G)
+    if not op_phases:
+        raise ValueError("plan has no reconfig ops (use a ChaosPlan for "
+                         "pure fault scenarios)")
+    # Per-group op signature -> Changer chain (validated once per
+    # distinct sequence, shared across the groups that follow it).
+    sig_of_group: List[Tuple[int, ...]] = []
+    for g in range(G):
+        sig_of_group.append(
+            tuple(i for i in op_phases if gsel_by_phase[i][g])
+        )
+    chains: Dict[Tuple[int, ...], List[_OpSlot]] = {}
+    for sig in set(sig_of_group):
+        chains[sig] = _walk_chain(plan, sig)
+    K = max(1, max(len(s) for s in sig_of_group))
+    op_start = np.full((K, G), NO_ROUND, dtype=np.int32)
+    n_ops = np.zeros(G, dtype=np.int32)
+    tgt_voter = np.zeros((K, P, G), dtype=bool)
+    tgt_outgoing = np.zeros((K, P, G), dtype=bool)
+    tgt_learner = np.zeros((K, P, G), dtype=bool)
+    added = np.zeros((K, P, G), dtype=bool)
+    removed = np.zeros((K, P, G), dtype=bool)
+    for g in range(G):
+        sig = sig_of_group[g]
+        n_ops[g] = len(sig)
+        for k, slot in enumerate(chains[sig]):
+            op_start[k, g] = phase_start[slot.phase]
+            for p in range(P):
+                pid = p + 1
+                tgt_voter[k, p, g] = pid in slot.voters_inc
+                tgt_outgoing[k, p, g] = pid in slot.voters_out
+                # learners_next stay outgoing voters until leave-joint
+                # materializes them (tracker.rs:50-83) — the device
+                # learner plane carries only the ACTIVE learners.
+                tgt_learner[k, p, g] = pid in slot.learners
+                added[k, p, g] = pid in slot.added
+                removed[k, p, g] = pid in slot.removed
+    return (
+        phase_of_round, append, op_start, n_ops,
+        tgt_voter, tgt_outgoing, tgt_learner, added, removed,
+        sig_of_group, chains,
+    )
+
+
+class CompiledReconfig(NamedTuple):
+    """Device schedule arrays for one plan at one batch shape.
+
+    phase_of_round: int32[R]       round -> phase index
+    append:         int32[NPH, G]  per-phase append workload
+    op_start:       int32[K, G]    round at which op k becomes eligible
+                                   (NO_ROUND padding past n_ops)
+    n_ops:          int32[G]       ops in the group's chain
+    tgt_voter:      bool[K, P, G]  post-apply incoming-voter mask
+    tgt_outgoing:   bool[K, P, G]  post-apply outgoing mask
+    tgt_learner:    bool[K, P, G]  post-apply learner mask
+    added:          bool[K, P, G]  fresh members (tracker-row reset + ra)
+    removed:        bool[K, P, G]  ex-members (tracker rows cleared)
+    n_peers:        static python int
+    """
+
+    phase_of_round: jnp.ndarray  # gc: int32[R]
+    append: jnp.ndarray  # gc: int32[NPH, G]
+    op_start: jnp.ndarray  # gc: int32[K, G]
+    n_ops: jnp.ndarray  # gc: int32[G]
+    tgt_voter: jnp.ndarray  # gc: bool[K, P, G]
+    tgt_outgoing: jnp.ndarray  # gc: bool[K, P, G]
+    tgt_learner: jnp.ndarray  # gc: bool[K, P, G]
+    added: jnp.ndarray  # gc: bool[K, P, G]
+    removed: jnp.ndarray  # gc: bool[K, P, G]
+    n_peers: int
+
+    @property
+    def n_rounds(self) -> int:
+        return int(self.phase_of_round.shape[0])
+
+
+def compile_plan(plan: ReconfigPlan, n_groups: int) -> CompiledReconfig:
+    """Lower a ReconfigPlan to device schedule arrays for `n_groups`
+    groups; every transition is Changer-validated host-side."""
+    (
+        phase_of_round, append, op_start, n_ops,
+        tgt_voter, tgt_outgoing, tgt_learner, added, removed,
+        _, _,
+    ) = _compile_schedule(plan, n_groups)
+    return CompiledReconfig(
+        phase_of_round=jnp.asarray(phase_of_round, dtype=jnp.int32),
+        append=jnp.asarray(append, dtype=jnp.int32),
+        op_start=jnp.asarray(op_start, dtype=jnp.int32),
+        n_ops=jnp.asarray(n_ops, dtype=jnp.int32),
+        tgt_voter=jnp.asarray(tgt_voter, dtype=bool),
+        tgt_outgoing=jnp.asarray(tgt_outgoing, dtype=bool),
+        tgt_learner=jnp.asarray(tgt_learner, dtype=bool),
+        added=jnp.asarray(added, dtype=bool),
+        removed=jnp.asarray(removed, dtype=bool),
+        n_peers=plan.n_peers,
+    )
+
+
+def initial_masks(
+    plan: ReconfigPlan, n_groups: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(voter_mask, outgoing_mask, learner_mask) [P, G] matching the
+    plan's bootstrap configuration — hand these to sim.init_state so the
+    sim starts in the config the compiled chains transition FROM."""
+    P, G = plan.n_peers, n_groups
+    vm = np.zeros((P, G), dtype=bool)
+    lm = np.zeros((P, G), dtype=bool)
+    for v in plan.voters:
+        vm[_peer(v, P, "initial voter", -1) - 1] = True
+    for l in plan.learners:
+        lm[_peer(l, P, "initial learner", -1) - 1] = True
+    return (
+        jnp.asarray(vm, dtype=bool),
+        jnp.zeros((P, G), dtype=bool),
+        jnp.asarray(lm, dtype=bool),
+    )
+
+
+class HostReconfigSchedule:
+    """The compiled reconfig schedule kept in numpy + python — what
+    simref.ReconfigOracle walks.  Carries the SAME timing/eligibility
+    arrays the device gathers (phase_of_round, append, op_start, n_ops)
+    plus, per (group, op-slot), the Changer-computed transition record
+    (_OpSlot: target config sets, progress-map delta, member delta) the
+    oracle's scalar surgery installs — both sides derive from ONE
+    _compile_schedule walk, so they cannot drift."""
+
+    def __init__(self, plan: ReconfigPlan, n_groups: int):
+        (
+            self.phase_of_round, self.append, self.op_start, self.n_ops,
+            self.tgt_voter, self.tgt_outgoing, self.tgt_learner,
+            self.added, self.removed,
+            self._sig_of_group, self._chains,
+        ) = _compile_schedule(plan, n_groups)
+        self.n_rounds = plan.n_rounds
+        self.n_peers = plan.n_peers
+        self.n_groups = n_groups
+        self.voters = list(plan.voters)
+        self.learners = list(plan.learners)
+
+    def slot(self, group: int, op_idx: int) -> _OpSlot:
+        """The validated transition record for the group's op `op_idx`."""
+        return self._chains[self._sig_of_group[group]][op_idx]
+
+
+class ReconfigState(NamedTuple):
+    """The runner's per-group op-protocol carry.
+
+    stage:         0 = next op (if any) needs proposing, 1 = a conf entry
+                   is in flight awaiting its dual-majority commit.
+    op_ptr:        index of the next unapplied op in the group's chain.
+    prop_owner:    proposing leader's peer id (1-based; 0 = none).
+    prop_index:    the in-flight conf entry's log index.
+    prop_term:     the proposing leader's term (the entry's term).
+    prev_voter/prev_outgoing: the mask planes that governed the PREVIOUS
+                   round's step — the double-change safety check compares
+                   each round's step masks against these, so every apply
+                   transition is audited exactly once (one round later;
+                   the post-scan tail check covers a final-round apply).
+    """
+
+    stage: jnp.ndarray  # gc: int32[G]
+    op_ptr: jnp.ndarray  # gc: int32[G]
+    prop_owner: jnp.ndarray  # gc: int32[G]
+    prop_index: jnp.ndarray  # gc: int32[G]
+    prop_term: jnp.ndarray  # gc: int32[G]
+    prev_voter: jnp.ndarray  # gc: bool[P, G]
+    prev_outgoing: jnp.ndarray  # gc: bool[P, G]
+
+
+def init_reconfig_state(st: sim_mod.SimState) -> ReconfigState:
+    """Fresh op-protocol state for a run starting from `st`.  Every field
+    is a DISTINCT buffer (the mask planes are copied): the runner donates
+    both the sim state and this carry, and an aliased buffer would be
+    donated twice."""
+    G = st.term.shape[1]
+    return ReconfigState(
+        stage=jnp.zeros((G,), jnp.int32),
+        op_ptr=jnp.zeros((G,), jnp.int32),
+        prop_owner=jnp.zeros((G,), jnp.int32),
+        prop_index=jnp.zeros((G,), jnp.int32),
+        prop_term=jnp.zeros((G,), jnp.int32),
+        prev_voter=jnp.array(st.voter_mask, dtype=bool),
+        prev_outgoing=jnp.array(st.outgoing_mask, dtype=bool),
+    )
+
+
+# Reconfig stats accumulator indices ([N_RECONFIG_STATS] int32; each slot
+# grows by at most G per round, and compile_plan bounds rounds x G < 2**31
+# — the GC008 no-wrap argument).
+RC_PROPOSED = 0  # conf entries appended (retries re-count)
+RC_APPLIED = 1  # mask swaps committed
+RC_RETRIES = 2  # pending entries invalidated by owner deposition/crash
+RC_JOINT_ROUNDS = 3  # (group, round) pairs spent inside a joint config
+N_RECONFIG_STATS = 4
+
+RECONFIG_STAT_NAMES = (
+    "proposals",
+    "ops_applied",
+    "retries",
+    "joint_group_rounds",
+)
+
+
+def _gather_peer(plane: jnp.ndarray, owner: jnp.ndarray) -> jnp.ndarray:
+    """plane[P, G], owner int32[G] (1-based, 0-safe) -> plane[owner-1, g]."""
+    o = jnp.clip(owner - 1, 0, plane.shape[0] - 1)
+    return jnp.take_along_axis(plane, o[None, :], axis=0)[0]
+
+
+def _gather_op(plane: jnp.ndarray, op_ptr: jnp.ndarray) -> jnp.ndarray:
+    """plane[K, ..., G], op_ptr int32[G] -> plane[op_ptr[g], ..., g]."""
+    k = jnp.clip(op_ptr, 0, plane.shape[0] - 1)
+    if plane.ndim == 2:
+        return jnp.take_along_axis(plane, k[None, :], axis=0)[0]
+    idx = jnp.broadcast_to(
+        k[None, None, :], (1, plane.shape[1], plane.shape[2])
+    )
+    return jnp.take_along_axis(plane, idx, axis=0)[0]
+
+
+def pending_in_horizon(
+    compiled: CompiledReconfig,
+    rst: ReconfigState,
+    round_idx: jnp.ndarray,  # gc: int32[]
+    horizon: int,
+) -> jnp.ndarray:
+    """bool[G]: groups with a conf entry in flight OR an op scheduled to
+    become eligible within the next `horizon` rounds — the mask
+    pallas_step.steady_mask must reject (a fused horizon cannot propose,
+    gate, or apply a conf change)."""
+    start = _gather_op(compiled.op_start, rst.op_ptr)
+    has_op = rst.op_ptr < compiled.n_ops
+    return (rst.stage > 0) | (
+        has_op & (start < round_idx + jnp.int32(horizon))
+    )
+
+
+def make_runner(
+    cfg: sim_mod.SimConfig,
+    compiled: CompiledReconfig,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+):
+    """Build the jitted whole-scenario runner: ONE lax.scan over every
+    round of the compiled reconfig schedule — per-round op eligibility,
+    the conf-entry propose/gate/apply protocol, the joint-window safety
+    fold, and the MTTR/reconfig stats folds all fuse into the scan body
+    with zero host round trips.  `chaos_compiled` (optional, equal
+    n_rounds/n_peers) threads a compiled fault schedule through the SAME
+    scan: the link/crash/loss masks gather exactly as chaos.make_runner's
+    (chaos.schedule_masks is shared), so membership changes run *during*
+    partitions.
+
+    Like the chaos runner, every schedule array enters the jit as a
+    RUNTIME ARGUMENT (GC012: a closed-over schedule would bake into the
+    jaxpr as consts); only the shapes specialize the compile.  Returns a
+    callable (state, health, rstate) -> (state', health', rstate',
+    stats[N_CHAOS_STATS], rstats[N_RECONFIG_STATS], safety[N_SAFETY]);
+    state/health/rstate are donated.  ``runner.jitted`` /
+    ``runner.schedule_args`` are exposed for the graftcheck trace audit.
+    """
+    n_rounds = compiled.n_rounds
+    P, G = cfg.n_peers, cfg.n_groups
+    if chaos_compiled is not None:
+        if chaos_compiled.n_rounds != n_rounds:
+            raise ValueError(
+                f"chaos plan spans {chaos_compiled.n_rounds} rounds but "
+                f"the reconfig plan spans {n_rounds} — phases must cover "
+                "the same horizon to compose in one scan"
+            )
+        if chaos_compiled.n_peers != compiled.n_peers:
+            raise ValueError("chaos and reconfig plans disagree on peers")
+    if compiled.n_peers != P:
+        raise ValueError(
+            f"plan has {compiled.n_peers} peers but cfg.n_peers == {P}"
+        )
+
+    def body(carry, r, sched, chaos_sched):
+        st, hl, rst, stats, rstats, safety = carry
+        ph = sched.phase_of_round[r]
+        append = sched.append[ph]
+        if chaos_sched is not None:
+            link, crashed, capp = chaos_mod.schedule_masks(chaos_sched, r)
+            append = append + capp
+        else:
+            link = None
+            crashed = jnp.zeros((P, G), bool)
+        # Op eligibility: the next unapplied op, once its phase starts.
+        start = _gather_op(sched.op_start, rst.op_ptr)
+        active = (rst.op_ptr < sched.n_ops) & (r >= start)
+        want_prop = active & (rst.stage == 0)
+        prev_leaderless = hl.planes[kernels.HP_LEADERLESS]
+        st2, hl2, prop = sim_mod.step(
+            cfg, st, crashed,
+            append + want_prop.astype(jnp.int32),
+            health=hl, link=link, reconfig_propose=want_prop,
+        )
+        # Record where the conf entry landed (owner 0 = no alive leader
+        # this round; the op stays at stage 0 and retries).
+        got = want_prop & (prop.owner > 0)
+        stage = jnp.where(got, 1, rst.stage)
+        powner = jnp.where(got, prop.owner, rst.prop_owner)
+        pindex = jnp.where(got, prop.index, rst.prop_index)
+        pterm = jnp.where(got, prop.term, rst.prop_term)
+        # The dual-majority commit gate, off the post-round planes: the
+        # owner still leads at its propose term (its log cannot have been
+        # overwritten — a leader only appends) and is not crashed (a
+        # frozen isolated owner can never advance), and its commit
+        # covers the entry.  Commit advancement itself already required
+        # BOTH majorities of the joint config (joint.rs min-of-halves in
+        # every step path), so `commit >= index` IS the dual-quorum gate.
+        own_lead = (
+            (_gather_peer(st2.state, powner) == kernels.ROLE_LEADER)
+            & (_gather_peer(st2.term, powner) == pterm)
+            & ~_gather_peer(crashed, powner)
+        )
+        committed = _gather_peer(st2.commit, powner) >= pindex
+        apply_mask = (stage == 1) & own_lead & committed
+        retry = (stage == 1) & ~own_lead
+        stage = jnp.where(apply_mask | retry, 0, stage)
+        # Joint-window safety invariants on the post-step (pre-apply)
+        # state under the masks that governed the step; the mask
+        # TRANSITION pair (prev round's step masks -> this round's) audits
+        # the previous round's apply.
+        safety = safety + kernels.check_safety(
+            st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
+            st.commit,
+            voter_mask=st2.voter_mask,
+            outgoing_mask=st2.outgoing_mask,
+            matched=st2.matched,
+            crashed=crashed,
+            prev_voter_mask=rst.prev_voter,
+            prev_outgoing_mask=rst.prev_outgoing,
+        )
+        # The gated swap: target masks of the op being applied, the
+        # reference's apply-time reactions on the batched planes.
+        (
+            state3, leader3, commit3, matched3, vm3, om3, lm3, ra3,
+        ) = kernels.apply_confchange(
+            st2.state, st2.leader_id, st2.commit, st2.term_start_index,
+            st2.matched, st2.voter_mask, st2.outgoing_mask,
+            st2.learner_mask,
+            _gather_op(sched.tgt_voter, rst.op_ptr),
+            _gather_op(sched.tgt_outgoing, rst.op_ptr),
+            _gather_op(sched.tgt_learner, rst.op_ptr),
+            _gather_op(sched.added, rst.op_ptr),
+            _gather_op(sched.removed, rst.op_ptr),
+            apply_mask,
+            st2.recent_active,
+        )
+        st3 = st2._replace(
+            state=state3, leader_id=leader3, commit=commit3,
+            matched=matched3, voter_mask=vm3, outgoing_mask=om3,
+            learner_mask=lm3, recent_active=ra3,
+        )
+        stats = chaos_mod.update_chaos_stats(
+            stats, prev_leaderless, hl2.planes[kernels.HP_LEADERLESS]
+        )
+        # dtype= on the counts: bare bool sums widen to int64 under x64
+        # (GC007) and these feed the int32 accumulator.
+        rstats = rstats + jnp.stack(
+            [
+                jnp.sum(got, dtype=jnp.int32),
+                jnp.sum(apply_mask, dtype=jnp.int32),
+                jnp.sum(retry, dtype=jnp.int32),
+                jnp.sum(jnp.any(om3, axis=0), dtype=jnp.int32),
+            ]
+        )
+        rst2 = ReconfigState(
+            stage=stage,
+            op_ptr=jnp.where(apply_mask, rst.op_ptr + 1, rst.op_ptr),
+            prop_owner=powner,
+            prop_index=pindex,
+            prop_term=pterm,
+            prev_voter=st2.voter_mask,
+            prev_outgoing=st2.outgoing_mask,
+        )
+        return (st3, hl2, rst2, stats, rstats, safety), ()
+
+    def run(st, hl, rst, *sched_args):
+        sched = compiled._replace(
+            phase_of_round=sched_args[0], append=sched_args[1],
+            op_start=sched_args[2], n_ops=sched_args[3],
+            tgt_voter=sched_args[4], tgt_outgoing=sched_args[5],
+            tgt_learner=sched_args[6], added=sched_args[7],
+            removed=sched_args[8],
+        )
+        if chaos_compiled is not None:
+            chaos_sched = chaos_compiled._replace(
+                phase_of_round=sched_args[9], link_packed=sched_args[10],
+                loss_packed=sched_args[11], crashed_packed=sched_args[12],
+                append=sched_args[13],
+            )
+        else:
+            chaos_sched = None
+        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
+        rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
+        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        carry, _ = jax.lax.scan(
+            lambda c, r: body(c, r, sched, chaos_sched),
+            (st, hl, rst, stats, rstats, safety),
+            jnp.arange(n_rounds, dtype=jnp.int32),
+        )
+        stf, hlf, rstf, stats, rstats, safety = carry
+        # Tail audit: the scan body checks each apply's mask transition
+        # one round later, so a final-round apply needs this one extra
+        # fold (prev_commit = final commit keeps the commit checks inert
+        # — only the transition + election-safety slots can fire).
+        safety = safety + kernels.check_safety(
+            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+            stf.commit,
+            voter_mask=stf.voter_mask,
+            outgoing_mask=stf.outgoing_mask,
+            matched=stf.matched,
+            prev_voter_mask=rstf.prev_voter,
+            prev_outgoing_mask=rstf.prev_outgoing,
+        )
+        return stf, hlf, rstf, stats, rstats, safety
+
+    jitted = jax.jit(run, donate_argnums=(0, 1, 2))
+    schedule_args = (
+        compiled.phase_of_round, compiled.append, compiled.op_start,
+        compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
+        compiled.tgt_learner, compiled.added, compiled.removed,
+    ) + (
+        (
+            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
+            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
+            chaos_compiled.append,
+        )
+        if chaos_compiled is not None
+        else ()
+    )
+
+    def runner(st, hl, rst):
+        return jitted(st, hl, rst, *schedule_args)
+
+    runner.jitted = jitted  # type: ignore[attr-defined]
+    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
+    return runner
+
+
+def run_plan(
+    cfg: sim_mod.SimConfig,
+    state: sim_mod.SimState,
+    compiled: CompiledReconfig,
+    health: Optional[sim_mod.HealthState] = None,
+    rstate: Optional[ReconfigState] = None,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+):
+    """Execute a whole compiled reconfig(+chaos) scenario in one jitted
+    lax.scan.  Returns (state', health', rstate', stats[N_CHAOS_STATS],
+    rstats[N_RECONFIG_STATS], safety[N_SAFETY]) — all device arrays;
+    nothing crosses to the host inside the run.  Health planes are
+    REQUIRED (MTTR stats ride on HP_LEADERLESS)."""
+    if health is None:
+        health = sim_mod.init_health(cfg)
+    if rstate is None:
+        rstate = init_reconfig_state(state)
+    return make_runner(cfg, compiled, chaos_compiled)(
+        state, health, rstate
+    )

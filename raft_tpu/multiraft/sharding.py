@@ -162,6 +162,50 @@ def sharded_read_index(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     )
 
 
+def reconfig_sharding(mesh: Mesh, axis: str = "groups"):
+    """NamedShardings for a reconfig run's arrays: the compiled schedule
+    (reconfig.CompiledReconfig) and the op-protocol carry
+    (reconfig.ReconfigState) both shard on the group axis like every
+    other [.., G] plane — per-group op chains are independent, so the
+    compiled scan partitions trivially with no collectives.  Returns
+    (schedule_shardings, state_shardings) as matching NamedTuples
+    (CompiledReconfig.n_peers and the round-indexed phase_of_round are
+    replicated: they are group-free)."""
+    from .reconfig import CompiledReconfig, ReconfigState
+
+    rep = NamedSharding(mesh, P())
+    g = NamedSharding(mesh, P(axis))
+    xg = NamedSharding(mesh, P(None, axis))
+    kpg = NamedSharding(mesh, P(None, None, axis))
+    sched = CompiledReconfig(
+        phase_of_round=rep, append=xg, op_start=xg, n_ops=g,
+        tgt_voter=kpg, tgt_outgoing=kpg, tgt_learner=kpg,
+        added=kpg, removed=kpg, n_peers=None,
+    )
+    rstate = ReconfigState(
+        stage=g, op_ptr=g, prop_owner=g, prop_index=g, prop_term=g,
+        prev_voter=xg, prev_outgoing=xg,
+    )
+    return sched, rstate
+
+
+def shard_reconfig(compiled, rstate, mesh: Mesh, axis: str = "groups"):
+    """Place a compiled reconfig schedule + carry on the mesh (the
+    device_put mirror of shard_state for the reconfig arrays)."""
+    sched_sh, rstate_sh = reconfig_sharding(mesh, axis)
+    placed_sched = compiled._replace(
+        **{
+            name: jax.device_put(
+                getattr(compiled, name), getattr(sched_sh, name)
+            )
+            for name in compiled._fields
+            if name != "n_peers"
+        }
+    )
+    placed_rstate = jax.tree.map(jax.device_put, rstate, rstate_sh)
+    return placed_sched, placed_rstate
+
+
 def run_sharded(
     cfg: SimConfig,
     mesh: Mesh,

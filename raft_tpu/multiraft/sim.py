@@ -25,8 +25,13 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
   * steady-state replication with per-round append workloads and quorum
     commit (term-gated, Raft §5.4.2 via the term_start_index trick);
   * joint-consensus configs (outgoing_mask: double-majority elections and
-    commits) and non-voting learners (learner_mask), with conf changes as
-    host-side mask-swap barriers;
+    commits) and non-voting learners (learner_mask), with conf changes
+    DEVICE-RESIDENT (ISSUE 10): compiled reconfig schedules
+    (raft_tpu/multiraft/reconfig.py) propose a real conf entry at the
+    acting leader (`step(..., reconfig_propose=)` reports where it
+    landed), gate the mask swap on its dual-majority commit, and apply
+    it in-scan via kernels.apply_confchange — composable with a chaos
+    plan in the same scan (`ClusterSim.run_reconfig`);
   * the linearizable ReadIndex barrier, Safe mode (`read_index` below);
   * fault injection at LINK granularity (the chaos engine,
     raft_tpu/multiraft/chaos.py): a directed reachability plane
@@ -48,10 +53,11 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     pathology pinned (tests/test_chaos_parity.py) next to its damped
     collapse (tests/test_damping_parity.py).  The ReadIndex barrier is
     link-aware via read_index(link=).
-  Not modeled on device (host path handles them): snapshots, conf-change
-  application (host-side mask-swap barriers; a swap under check_quorum
-  does not carry the scalar side's added-node recent_active=True grace,
-  so pair swaps with a fresh election or accept one early boundary).
+  Not modeled on device (host path handles them): snapshots and entry
+  payloads (the device sees cursor effects only) and ad-hoc conf changes
+  OUTSIDE a compiled plan — a manual host-side mask swap still works but
+  skips the commit gate, the added-node recent_active grace, and the
+  joint-window safety audit that the reconfig runner provides.
 
 Log model: each peer's log is summarized by (last_index, last_term) plus
 the pairwise agreement plane `agree[a, b]` (common-prefix length).  Logs DO
@@ -207,6 +213,21 @@ def init_health(cfg: SimConfig) -> HealthState:
     )
 
 
+class ReconfigProposal(NamedTuple):
+    """Where this round's conf-change entry landed, per group (the step
+    extra behind `step(..., reconfig_propose=)`): owner is the acting
+    leader's peer id (0 = no alive leader, nothing proposed), index the
+    entry's log index (the group's append workload plus the conf entry,
+    appended last), term the owner's term at propose time.  The reconfig
+    runner (raft_tpu/multiraft/reconfig.py) records these as the pending
+    joint log position whose commit under BOTH majorities gates the mask
+    swap."""
+
+    owner: jnp.ndarray  # gc: int32[G]
+    index: jnp.ndarray  # gc: int32[G]
+    term: jnp.ndarray  # gc: int32[G]
+
+
 def _node_key(
     cfg: SimConfig, group_ids: Optional[jnp.ndarray] = None
 ) -> jnp.ndarray:
@@ -346,6 +367,7 @@ def step(
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
     link: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
+    reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -370,10 +392,18 @@ def step(
                traced graph is bit-identical to the pre-chaos build — the
                choice is trace-time static, like counters/health.
 
-    Extras are appended to the return value in (counters, health) order for
-    whichever are given — (state,), (state, counters), (state, health), or
-    (state, counters, health); bare `state` when neither.  Both choices are
-    trace-time static: the counters=None/health=None graph is unchanged.
+    reconfig_propose: optional bool[G] — groups whose pending conf-change
+    op proposes its conf entry at the acting leader this round.  The
+    CALLER adds the +1 entry to `append_n`; this mask only makes the step
+    REPORT where the workload landed, as a ReconfigProposal extra (owner 0
+    where no alive leader acted, so the op retries next round).
+
+    Extras are appended to the return value in (counters, health,
+    proposal) order for whichever are given — (state,), (state, counters),
+    (state, health), (state, counters, health), each with the
+    ReconfigProposal appended when reconfig_propose is given; bare `state`
+    when none.  All choices are trace-time static: the
+    counters=None/health=None/reconfig_propose=None graph is unchanged.
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -391,11 +421,13 @@ def step(
                 (cfg.n_peers, cfg.n_peers, cfg.n_groups), bool
             )
         return _damped_linked_step(
-            cfg, st, crashed, append_n, link, group_ids, counters, health
+            cfg, st, crashed, append_n, link, group_ids, counters, health,
+            reconfig_propose,
         )
     if link is not None:
         return _linked_step(
-            cfg, st, crashed, append_n, link, group_ids, counters, health
+            cfg, st, crashed, append_n, link, group_ids, counters, health,
+            reconfig_propose,
         )
     G, P = cfg.n_groups, cfg.n_peers
     self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
@@ -835,7 +867,7 @@ def step(
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
     )
-    if counters is None and health is None:
+    if counters is None and health is None and reconfig_propose is None:
         return out
     # A group wins at most one election per round (quorum uniqueness), and
     # the solo crashed-campaigner path is mutually exclusive with the
@@ -869,6 +901,15 @@ def step(
             campaigned & ~won_any,
         )
         extras = extras + (HealthState(planes, pos),)
+    if reconfig_propose is not None:
+        prop_mask = has_leader & reconfig_propose
+        extras = extras + (
+            ReconfigProposal(
+                owner=jnp.where(prop_mask, first_l + 1, 0),
+                index=jnp.where(prop_mask, lead_last, 0),
+                term=jnp.where(prop_mask, lead_term, 0),
+            ),
+        )
     return (out,) + extras
 
 
@@ -881,6 +922,7 @@ def _linked_step(
     group_ids: Optional[jnp.ndarray] = None,
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
+    reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
 ) -> Union[SimState, Tuple]:
     """The pairwise (link-gated) protocol round behind `step(..., link=)`.
 
@@ -1418,7 +1460,7 @@ def _linked_step(
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
     )
-    if counters is None and health is None:
+    if counters is None and health is None and reconfig_propose is None:
         return out
     won_any = jnp.any(won, axis=0)
     extras: Tuple = ()
@@ -1443,6 +1485,19 @@ def _linked_step(
             campaigned & ~won_any,
         )
         extras = extras + (HealthState(planes, pos),)
+    if reconfig_propose is not None:
+        # Where the round's conf entry landed (lead_last is the leader's
+        # post-append last index — the conf entry is appended LAST, after
+        # the round's workload); owner 0 where no alive leader acted, so
+        # the pending op retries next round.
+        prop_mask = has_leader & reconfig_propose
+        extras = extras + (
+            ReconfigProposal(
+                owner=jnp.where(prop_mask, first_l + 1, 0),
+                index=jnp.where(prop_mask, lead_last, 0),
+                term=jnp.where(prop_mask, lead_term, 0),
+            ),
+        )
     return (out,) + extras
 
 
@@ -1455,6 +1510,7 @@ def _damped_linked_step(
     group_ids: Optional[jnp.ndarray] = None,
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
+    reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
 ) -> Union[SimState, Tuple]:
     """The damped (check-quorum / pre-vote / lease) pairwise round.
 
@@ -2438,7 +2494,7 @@ def _damped_linked_step(
         learner_mask=st.learner_mask,
         recent_active=RA,
     )
-    if counters is None and health is None:
+    if counters is None and health is None and reconfig_propose is None:
         return out
     extras: Tuple = ()
     if counters is not None:
@@ -2479,6 +2535,21 @@ def _damped_linked_step(
             campaigned & ~won_end,
         )
         extras = extras + (HealthState(planes, pos),)
+    if reconfig_propose is not None:
+        # The proposal is recorded at the WORKLOAD stage (the conf entry is
+        # appended there, last in the round's batch); a workload nudge that
+        # deposes the acting leader afterwards does not unrecord it — the
+        # entry landed, exactly like the scalar leader that appends before
+        # processing its deposing ack.  The reconfig runner's gate then
+        # sees the deposed owner and retries the op.
+        prop_mask = has_leader & reconfig_propose
+        extras = extras + (
+            ReconfigProposal(
+                owner=jnp.where(prop_mask, first_l + 1, 0),
+                index=jnp.where(prop_mask, lead_last, 0),
+                term=jnp.where(prop_mask, lead_term, 0),
+            ),
+        )
     return (out,) + extras
 
 
@@ -2917,6 +2988,121 @@ class ClusterSim:
         )
         if self.health_monitor is not None:
             self.health_monitor.record_scenario(report)
+        return report
+
+    # --- reconfig engine (see raft_tpu/multiraft/reconfig.py) ---
+
+    def run_reconfig(
+        self, plan, chaos_plan=None, stall_timeouts: int = 4
+    ) -> dict:
+        """Execute a membership-churn plan (reconfig.ReconfigPlan or
+        CompiledReconfig) as ONE jitted lax.scan — the conf-entry
+        propose/gate/apply protocol, the joint-window safety fold, and
+        the MTTR/op stats all fuse into the scan with zero host round
+        trips — optionally composed with a chaos plan of equal length
+        (reconfig DURING partition/loss/crash).  Returns the scenario
+        report (health.HealthMonitor.reconfig_report).
+
+        Requires SimConfig(collect_health=True).  The sim's state/health
+        planes advance in place and the sim's config masks end in the
+        plan's final configuration; the compiled schedules and scan are
+        cached, so repeated calls pay one compile.  `stall_timeouts`
+        drives the reconfig-stall detection: a group still in a joint
+        config whose commit has been flat for `stall_timeouts *
+        election_tick` rounds counts as reconfig-stalled (surfaced as the
+        health.reconfig_stall event + gauge through an attached
+        HealthMonitor) — no new device plane, just the existing
+        commit-stall plane joined with the joint bit.
+        """
+        from . import chaos as chaos_mod
+        from . import reconfig as reconfig_mod
+        from .health import HealthMonitor
+
+        health = self._require_health()
+        if isinstance(plan, reconfig_mod.ReconfigPlan):
+            # Pre-flight: plans apply ABSOLUTE Changer-computed target
+            # masks walked from the plan's bootstrap config, so the sim
+            # must start in exactly that config — a mismatch (e.g.
+            # re-running a plan from its own end state) would swap in
+            # masks unrelated to the live membership.  The joint-window
+            # safety audit catches that too, but as an end-of-run
+            # violation count; fail actionably up front instead.
+            import numpy as np
+
+            want = reconfig_mod.initial_masks(plan, self.cfg.n_groups)
+            # graftcheck: allow-no-host-sync-in-jit — cheap [P, G]
+            # pre-flight download, before the jitted scan starts.
+            cur = jax.device_get(
+                (self.state.voter_mask, self.state.outgoing_mask,
+                 self.state.learner_mask)
+            )
+            # graftcheck: allow-no-host-sync-in-jit — materializing the
+            # plan's host-built masks for the host-side comparison.
+            want_h = [np.asarray(w) for w in want]
+            if not all(
+                np.array_equal(c, w) for c, w in zip(cur, want_h)
+            ):
+                raise ValueError(
+                    "sim state masks do not match the plan's bootstrap "
+                    "config (voters/learners); start from "
+                    "sim.init_state(cfg, *reconfig.initial_masks(plan, "
+                    "G)) — plans apply absolute target masks, not deltas"
+                )
+        # Cache key holds the plan OBJECTS and compares with `is` (like
+        # the chaos runner cache): an id()-based key could alias a new
+        # plan at a garbage-collected plan's address and silently replay
+        # the old schedule.  A cache hit also reuses the lowered
+        # CompiledReconfig, so repeated calls skip the Changer chain walk
+        # and schedule re-upload entirely.
+        cached = getattr(self, "_reconfig_runner", None)
+        if (
+            cached is None
+            or cached[0] is not plan
+            or cached[1] is not chaos_plan
+        ):
+            if isinstance(plan, reconfig_mod.CompiledReconfig):
+                compiled = plan
+            else:
+                compiled = reconfig_mod.compile_plan(
+                    plan, self.cfg.n_groups
+                )
+            if chaos_plan is None or isinstance(
+                chaos_plan, chaos_mod.CompiledChaos
+            ):
+                chaos_compiled = chaos_plan
+            else:
+                chaos_compiled = chaos_mod.compile_plan(
+                    chaos_plan, self.cfg.n_groups
+                )
+            runner = reconfig_mod.make_runner(
+                self.cfg, compiled, chaos_compiled
+            )
+            self._reconfig_runner = (plan, chaos_plan, compiled, runner)
+        else:
+            compiled, runner = cached[2], cached[3]
+        rst = reconfig_mod.init_reconfig_state(self.state)
+        (
+            self.state, self._health, self._reconfig_state,
+            stats, rstats, safety,
+        ) = runner(self.state, health, rst)
+        # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
+        # download of fixed-size stat vectors + two small planes,
+        # outside the jitted scan.
+        stats_h, rstats_h, safety_h, om_h, since_h = jax.device_get(
+            (stats, rstats, safety, self.state.outgoing_mask,
+             self._health.planes[kernels.HP_SINCE_COMMIT])
+        )
+        n_stuck, worst = HealthMonitor.reconfig_stall_groups(
+            om_h, since_h, self.cfg.election_tick,
+            stall_timeouts=stall_timeouts,
+            topk=min(self.cfg.health_topk, self.cfg.n_groups),
+        )
+        report = HealthMonitor.reconfig_report(
+            stats_h, rstats_h, safety_h, compiled.n_rounds,
+            n_stuck, worst,
+        )
+        if self.health_monitor is not None:
+            self.health_monitor.record_reconfig(report)
         return report
 
     def counters(self) -> dict:

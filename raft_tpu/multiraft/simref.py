@@ -9,6 +9,14 @@ append workload at the acting leader → pump.
 Commit-index parity between this and ClusterSim on identical crash/append
 schedules is THE correctness claim of the batched backend (BASELINE.json's
 "bit-identical commit indices").
+
+The oracle family layers on top of ScalarCluster: HealthOracle folds the
+numpy twin of the device health planes each round; ChaosOracle replays a
+compiled fault schedule (chaos.HostSchedule) through it; ReconfigOracle
+(ISSUE 10) additionally walks a compiled membership-churn schedule
+(reconfig.HostReconfigSchedule) — proposing real conf entries, gating on
+the dual-majority commit, and applying the Changer-computed config by
+scalar surgery — the exact twin of reconfig.make_runner's scan.
 """
 
 from __future__ import annotations
@@ -96,7 +104,8 @@ class ScalarCluster:
 
     def round(self, crashed: Optional[np.ndarray] = None,
               append_n: Optional[np.ndarray] = None,
-              link: Optional[np.ndarray] = None) -> None:
+              link: Optional[np.ndarray] = None,
+              conf_propose: Optional[np.ndarray] = None):
         """One lockstep protocol round across all groups.
 
         crashed:  bool[G, P] whole-peer isolation for the round.
@@ -104,11 +113,25 @@ class ScalarCluster:
         link:     optional bool[P, P, G] directed reachability (peer-major
                   src/dst axes, like the device plane); a down link drops
                   every message on that edge for the whole round.
+        conf_propose: optional bool[G] — groups whose pending conf-change
+                  op proposes its entry this round (the scalar twin of
+                  sim.step's reconfig_propose): ONE extra entry joins the
+                  group's propose batch, appended LAST.  Returns a list of
+                  per-group (owner, index, term) records — the acting
+                  leader's id, the conf entry's log index, and the
+                  leader's term at propose time, or (0, 0, 0) where no
+                  alive leader acted — mirroring sim.ReconfigProposal
+                  bit-for-bit.  Returns None when conf_propose is None.
         """
         if crashed is None:
             crashed = np.zeros((self.n_groups, self.n_peers), dtype=bool)
         if append_n is None:
             append_n = np.zeros((self.n_groups,), dtype=np.int64)
+        props = (
+            None
+            if conf_propose is None
+            else [(0, 0, 0)] * self.n_groups
+        )
         for g, net in enumerate(self.networks):
             self._apply_crash_mask(
                 net, crashed[g], None if link is None else link[:, :, g]
@@ -125,10 +148,24 @@ class ScalarCluster:
             # Propose the append workload at the acting leader (the alive
             # leader with the highest term).
             n = int(append_n[g])
-            if n > 0:
+            extra = conf_propose is not None and bool(conf_propose[g])
+            total = n + (1 if extra else 0)
+            if total > 0:
                 lead = self.acting_leader(g, crashed[g])
                 if lead is not None:
-                    ents = [Entry(data=b"x") for _ in range(n)]
+                    if extra:
+                        # The conf entry's landing spot, captured BEFORE
+                        # the propose pump (the leader appends the batch
+                        # first thing; later traffic in the pump can
+                        # depose it but never unappend) — matches the
+                        # device extra's workload-stage snapshot.
+                        r = net.peers[lead].raft
+                        props[g] = (
+                            lead,
+                            r.raft_log.last_index() + total,
+                            r.term,
+                        )
+                    ents = [Entry(data=b"x") for _ in range(total)]
                     net.send([
                         Message(
                             msg_type=MessageType.MsgPropose,
@@ -137,6 +174,7 @@ class ScalarCluster:
                             entries=ents,
                         )
                     ])
+        return props
 
     def acting_leader(self, g: int, crashed_row: Sequence[bool]) -> Optional[int]:
         best = None
@@ -247,11 +285,14 @@ class HealthOracle:
                 commit[g, p] = r.raft_log.committed
         return state, term, commit, int(StateRole.Leader)
 
-    def round(self, crashed=None, append_n=None, link=None) -> None:
+    def round(self, crashed=None, append_n=None, link=None,
+              conf_propose=None):
         """Drive one cluster round and fold its health facts into the
         planes (the scalar twin of sim.step's health extra).  `link` is
-        the optional bool[P, P, G] chaos reachability plane, passed
-        through to ScalarCluster.round."""
+        the optional bool[P, P, G] chaos reachability plane and
+        `conf_propose` the optional bool[G] conf-entry propose mask, both
+        passed through to ScalarCluster.round; returns its proposal
+        records (None unless conf_propose is given)."""
         G, P = self.cluster.n_groups, self.cluster.n_peers
         if crashed is None:
             crashed = np.zeros((G, P), dtype=bool)
@@ -266,7 +307,7 @@ class HealthOracle:
                     and r.election_elapsed + 1 >= r.randomized_election_timeout
                 )
 
-        self.cluster.round(crashed, append_n, link)
+        props = self.cluster.round(crashed, append_n, link, conf_propose)
 
         post_state, post_term, post_commit, _ = self._capture()
         alive = ~np.asarray(crashed, dtype=bool)
@@ -293,6 +334,7 @@ class HealthOracle:
             np.int32
         )
         self.window_pos = (self.window_pos + 1) % self.window
+        return props
 
 
 class ChaosOracle(HealthOracle):
@@ -329,3 +371,165 @@ class ChaosOracle(HealthOracle):
         # Schedule planes are peer-major [P, G]; the scalar round wants
         # [G, P] crash rows.
         self.round(crashed=crashed.T, append_n=append, link=link)
+
+
+class ReconfigOracle(HealthOracle):
+    """Scalar-side oracle for compiled membership-churn schedules.
+
+    Replays a compiled reconfig schedule (reconfig.HostReconfigSchedule —
+    the numpy/python twin of the device schedule arrays, derived from the
+    SAME Changer-validated chain walk), optionally composed with a chaos
+    schedule (chaos.HostSchedule), through real Raft state machines:
+    each round runs the standard lockstep round with the round's faults
+    and the pending op's conf-entry propose (ScalarCluster.round's
+    conf_propose), applies the IDENTICAL propose/gate/retry rules the
+    device runner folds into its scan (reconfig.make_runner), and — when
+    a group's gate fires — performs the scalar surgery mirror of
+    kernels.apply_confchange on every peer of the group at once:
+    tracker.apply_conf with the Changer-computed configuration + map
+    delta (fresh rows get the added-node recent_active grace and the
+    device model's paused-probe discipline), promotable refresh,
+    leader-step-down for peers leaving the config (raw role/leader_id
+    surgery — no become_follower timer side effects, matching the
+    kernel), and the quorum-shrink commit pickup via Raft.maybe_commit
+    (no broadcast — the round's ordinary traffic propagates it).
+
+    tests/test_reconfig_parity.py asserts exact per-round equality of
+    every peer's state AND the health planes against the device runner
+    stepping the identical schedule.
+
+    This class is the resolved GC010 oracle symbol for the reconfig
+    kernels (tools/graftcheck/parity_obligations.json: apply_confchange /
+    check_safety -> simref.ReconfigOracle); renaming it or its entry
+    points is an obligation change and must go through
+    `make obligations`.
+    """
+
+    def __init__(self, cluster: ScalarCluster, schedule,
+                 chaos_schedule=None, window: int = 32):
+        super().__init__(cluster, window=window)
+        self.schedule = schedule
+        self.chaos = chaos_schedule
+        if chaos_schedule is not None:
+            if chaos_schedule.n_rounds != schedule.n_rounds:
+                raise ValueError(
+                    "chaos and reconfig schedules disagree on rounds"
+                )
+            if chaos_schedule.n_peers != schedule.n_peers:
+                raise ValueError(
+                    "chaos and reconfig schedules disagree on peers"
+                )
+        G = cluster.n_groups
+        self.round_idx = 0
+        self.stage = np.zeros(G, dtype=np.int64)
+        self.op_ptr = np.zeros(G, dtype=np.int64)
+        self.prop_owner = np.zeros(G, dtype=np.int64)
+        self.prop_index = np.zeros(G, dtype=np.int64)
+        self.prop_term = np.zeros(G, dtype=np.int64)
+
+    @staticmethod
+    def _regime_start(raft) -> int:
+        """First index of the leader's current-term regime in its own log
+        (the device's term_start_index): a leader's log tail is its
+        regime, so walk back while the term matches."""
+        idx = raft.raft_log.last_index()
+        if raft.raft_log.term_or(idx) != raft.term:
+            return idx + 1  # defensive: no regime entries yet
+        while idx > 1 and raft.raft_log.term_or(idx - 1) == raft.term:
+            idx -= 1
+        return idx
+
+    def _apply_surgery(self, g: int, slot) -> None:
+        """The scalar mirror of kernels.apply_confchange for ONE group:
+        identical mask swap, tracker-row delta, step-down, and commit
+        pickup on every peer simultaneously."""
+        from ..confchange.changer import MapChangeType
+        from ..tracker import Configuration
+
+        net = self.cluster.networks[g]
+        for p in range(1, self.cluster.n_peers + 1):
+            r = net.peers[p].raft
+            conf = Configuration(
+                voters=slot.voters_inc, learners=slot.learners
+            )
+            conf.voters.outgoing.voters.update(slot.voters_out)
+            conf.learners_next = set(slot.learners_next)
+            changes = [
+                (i, MapChangeType(ct)) for i, ct in slot.changes
+            ]
+            # Fresh rows start at the reference's next_idx; for an acting
+            # leader the device probe model derives the first-probe prev
+            # from its term-start cursor (sim.py's never-acked rule), so
+            # the leader's fresh rows get next = its regime start.
+            if r.state == StateRole.Leader:
+                next_idx = self._regime_start(r)
+            else:
+                next_idx = r.raft_log.last_index() + 1
+            r.prs.apply_conf(conf, changes, next_idx)
+            for i, ct in changes:
+                if ct == MapChangeType.Add:
+                    # apply_conf granted recent_active (the added-node
+                    # grace); the device additionally models the fresh
+                    # row as a PAUSED probe — appends skip it until a
+                    # heartbeat response resumes it.
+                    r.prs.get_mut(i).paused = True
+            in_config = conf.voters.contains(r.id)
+            r.promotable = in_config
+            if r.state != StateRole.Follower and not in_config:
+                # Leader-step-down when the peer leaves the config: raw
+                # role surgery exactly like the kernel — no
+                # become_follower timer reset or timeout redraw.
+                r.state = StateRole.Follower
+                r.leader_id = 0
+            elif r.state == StateRole.Leader:
+                # Quorum-shrink commit pickup under the NEW config (the
+                # reference's post_conf_change maybe_commit), without the
+                # broadcast — the round's ordinary traffic propagates it.
+                r.maybe_commit()
+
+    def scheduled_round(self) -> None:
+        """Advance one round: faults + eligibility + propose + gate +
+        surgery, in exactly the device runner's order."""
+        r = self.round_idx
+        sch = self.schedule
+        G, P = sch.n_groups, sch.n_peers
+        if self.chaos is not None:
+            link, crashed, capp = self.chaos.masks(r)
+            append = sch.append[sch.phase_of_round[r]] + capp
+        else:
+            link = None
+            crashed = np.zeros((P, G), dtype=bool)
+            append = sch.append[sch.phase_of_round[r]]
+        k = np.clip(self.op_ptr, 0, sch.op_start.shape[0] - 1)
+        start = sch.op_start[k, np.arange(G)]
+        active = (self.op_ptr < sch.n_ops) & (r >= start)
+        want = active & (self.stage == 0)
+        props = self.round(
+            crashed=crashed.T, append_n=append, link=link,
+            conf_propose=want,
+        )
+        for g in range(G):
+            if want[g] and props[g][0] > 0:
+                self.stage[g] = 1
+                (
+                    self.prop_owner[g],
+                    self.prop_index[g],
+                    self.prop_term[g],
+                ) = props[g]
+        for g in range(G):
+            if self.stage[g] != 1:
+                continue
+            o = int(self.prop_owner[g])
+            raft = self.cluster.networks[g].peers[o].raft
+            own_lead = (
+                raft.state == StateRole.Leader
+                and raft.term == self.prop_term[g]
+                and not crashed[o - 1, g]
+            )
+            if own_lead and raft.raft_log.committed >= self.prop_index[g]:
+                self._apply_surgery(g, sch.slot(g, int(self.op_ptr[g])))
+                self.op_ptr[g] += 1
+                self.stage[g] = 0
+            elif not own_lead:
+                self.stage[g] = 0  # retry at the next acting leader
+        self.round_idx += 1

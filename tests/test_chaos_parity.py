@@ -182,7 +182,7 @@ def test_check_safety_flags_each_invariant():
         agree=jnp.full((2, 2, g), 6, jnp.int32),
         prev_commit=planes(5),
     )
-    assert np.asarray(clean).tolist() == [0, 0, 0, 0]
+    assert np.asarray(clean).tolist() == [0] * kernels.N_SAFETY
     # two leaders in one term
     dual = kernels.check_safety(
         state=jnp.asarray([[2] * g, [2] * g], jnp.int32),

@@ -231,6 +231,60 @@ def _chaos_runner_builder():
     return build
 
 
+def _reconfig_runner_builder(with_chaos: bool, damping: dict):
+    def build() -> Built:
+        from raft_tpu.multiraft import chaos, reconfig
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, **damping
+        )
+        plan = reconfig.ReconfigPlan(
+            name="graftcheck-inventory",
+            n_peers=P,
+            phases=[
+                reconfig.ReconfigPhase(rounds=4, append=1),
+                reconfig.ReconfigPhase(
+                    rounds=4,
+                    op={"enter_joint": [{"add": 3}]},
+                ),
+                reconfig.ReconfigPhase(
+                    rounds=4, op={"leave_joint": True}
+                ),
+            ],
+            voters=[1, 2],
+        )
+        compiled = reconfig.compile_plan(plan, G)
+        chaos_compiled = None
+        if with_chaos:
+            cplan = chaos.ChaosPlan(
+                name="graftcheck-inventory",
+                n_peers=P,
+                phases=[
+                    chaos.ChaosPhase(
+                        rounds=8, partition=[[1], [2, 3]], loss_all=0.05
+                    ),
+                    chaos.ChaosPhase(rounds=4, append=1),
+                ],
+            )
+            chaos_compiled = chaos.compile_plan(cplan, G)
+        vm, om, lm = reconfig.initial_masks(plan, G)
+        st = sim.init_state(cfg, vm, om, lm)
+        runner = reconfig.make_runner(cfg, compiled, chaos_compiled)
+        # make_runner exposes its underlying jit and full argument list
+        # (state, health, rstate, *schedule arrays) for this audit.
+        return Built(
+            runner.jitted,
+            (
+                st, sim.init_health(cfg),
+                reconfig.init_reconfig_state(st),
+            ) + runner.schedule_args,
+            (0, 1, 2),
+        )
+
+    return build
+
+
 def _sharded_builder(kind: str):
     def build() -> Built:
         import jax
@@ -353,6 +407,29 @@ def _specs() -> List[GraphSpec]:
             name="chaos_runner@health",
             anchor="raft_tpu/multiraft/chaos.py",
             build=_chaos_runner_builder(),
+        )
+    )
+    reconfig_py = "raft_tpu/multiraft/reconfig.py"
+    out.append(
+        GraphSpec(
+            # The ISSUE 10 compiled membership-churn scan: state + health
+            # + the op-protocol carry all donated; schedule arrays are
+            # runtime args (the chaos runner's GC012 lesson, applied from
+            # birth).
+            name="reconfig_runner@health",
+            anchor=reconfig_py,
+            build=_reconfig_runner_builder(False, {}),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # reconfig DURING chaos in one scan, damped (cq+pv) — the
+            # BASELINE config 4 production shape.
+            name="reconfig_runner@chaos+cq+pv",
+            anchor=reconfig_py,
+            build=_reconfig_runner_builder(
+                True, {"check_quorum": True, "pre_vote": True}
+            ),
         )
     )
     sharding_py = "raft_tpu/multiraft/sharding.py"

@@ -1,0 +1,129 @@
+"""Golden-corpus reconfig replay report (the ISSUE 10 CI artifact).
+
+Runs every scenario in tests/testdata/reconfig/plans.json — a
+ReconfigPlan riding the ChaosPlan the corpus pairs it with (membership
+churn DURING partition/link-loss/crash) — through the compiled
+reconfig+chaos scan (ClusterSim.run_reconfig) and writes one JSON
+document summarizing each run:
+
+    {"groups": 128, "plans": {
+        "joint_entry_split": {
+            "undamped": {"proposals": ..., "ops_applied": ...,
+                         "retries": ..., "joint_group_rounds": ...,
+                         "mttr_rounds": ..., "reelections": ...,
+                         "reconfig_stalled_groups": ..., "safety": {...}},
+            "damped":   {...}},  ...}}
+
+Both halves replay the identical schedule; `damped` turns on the full
+election-damping configuration (SimConfig check_quorum + pre_vote), so
+the joint-window safety invariants get CI coverage in the production
+configuration as well.
+
+The step fails (exit 2) if ANY safety-invariant count in EITHER
+configuration is non-zero on ANY scenario — the joint window must stay
+safe under every corpus fault pattern.  It also fails if the
+`joint_exit_blocked` scenario does NOT report reconfig-stalled groups in
+the undamped half: that scenario downs the outgoing majority precisely
+so the group sits in joint past the stall threshold, and a silent zero
+there means the stall detection (the health.reconfig_stall surface)
+has rotted.
+
+Usage:  python tools/reconfig_report.py [--groups N] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CORPUS = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "testdata", "reconfig",
+    "plans.json",
+)
+
+_KEEP = (
+    "proposals", "ops_applied", "retries", "joint_group_rounds",
+    "mttr_rounds", "reelections", "max_leaderless_streak",
+    "reconfig_stalled_groups", "safety",
+)
+
+
+def run_scenario(doc: dict, groups: int, damped: bool) -> dict:
+    from raft_tpu.multiraft import ClusterSim, SimConfig, chaos, reconfig
+
+    plan = reconfig.plan_from_dict(doc["reconfig"])
+    cplan = chaos.plan_from_dict(doc["chaos"])
+    cfg = SimConfig(
+        n_groups=groups,
+        n_peers=plan.n_peers,
+        collect_health=True,
+        check_quorum=damped,
+        pre_vote=damped,
+    )
+    sim = ClusterSim(cfg, *reconfig.initial_masks(plan, groups))
+    report = sim.run_reconfig(plan, cplan)
+    return {k: report[k] for k in _KEEP}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--groups", type=int, default=128)
+    ap.add_argument("--out", default="", metavar="FILE")
+    args = ap.parse_args()
+
+    with open(CORPUS, "r", encoding="utf-8") as f:
+        corpus = json.load(f)
+
+    out = {"groups": args.groups, "plans": {}}
+    failures = []
+    for doc in corpus:
+        name = doc["name"]
+        entry = {}
+        for label, damped in (("undamped", False), ("damped", True)):
+            rep = run_scenario(doc, args.groups, damped)
+            entry[label] = rep
+            if any(rep["safety"].values()):
+                failures.append(
+                    f"{name} [{label}]: safety violations {rep['safety']}"
+                )
+        if (
+            name == "joint_exit_blocked"
+            and entry["undamped"]["reconfig_stalled_groups"] == 0
+        ):
+            failures.append(
+                "joint_exit_blocked [undamped]: expected reconfig-stalled "
+                "groups (downed outgoing majority pins the joint window) "
+                "but the stall detection reported none"
+            )
+        out["plans"][name] = entry
+        print(f"{name}: "
+              + ", ".join(
+                  f"{label} applied={rep['ops_applied']} "
+                  f"retries={rep['retries']} "
+                  f"stalled={rep['reconfig_stalled_groups']}"
+                  for label, rep in entry.items()
+              ),
+              file=sys.stderr)
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+
+    if failures:
+        for msg in failures:
+            print(f"ERROR: {msg}", file=sys.stderr)
+        return 2
+    print(f"reconfig report: {len(out['plans'])} scenarios, "
+          "all safety invariants zero", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import raft_tpu.platform
+
+    raft_tpu.platform.enable_compile_cache()
+    raise SystemExit(main())
