@@ -9,7 +9,18 @@ Raft core), and prints ONE JSON line:
 
   {"metric": ..., "value": ..., "unit": "ticks/sec", "vs_baseline": ...,
    "reps": R, "min": ..., "median": ..., "max": ..., "spread_pct": ...,
-   "spread_flagged": bool}
+   "spread_flagged": bool, "fused_rounds": N, "total_rounds": M,
+   "fused_frac": N/M}
+
+Fused-fraction honesty (ISSUE 11): every JSON line carries the MEASURED
+fused-kernel coverage of its timed region — `fused_rounds`/`total_rounds`
+in group-rounds (one group advancing one protocol round) and their ratio
+`fused_frac` — threaded through the dispatchers as an in-graph int32
+accumulator (pallas_step count_fused), never inferred from a predicate
+log line.  The same count folds into the in-process metrics registry
+(bench.METRICS) as the `multiraft_fused_rounds_total` counter.
+`--fused-floor X` exits 1 when fused_frac lands below X (the CI
+production-suite assertion).
 
 Variance-aware methodology (docs/OBSERVABILITY.md): the timed region is
 repeated REPS (≥5) times and the headline `value` is the MEDIAN ticks/sec,
@@ -54,12 +65,16 @@ which path was measured: the steady path keeps the historical
 riding the ISSUE 8 fused damped kernel; the retired `_cq` series was the
 pre-fusion wave-replay number).  --check-quorum composes with --lossy
 (`..._chaos_cq_fused`): the lossless damped predicate proves every
-check-quorum boundary passes so the fused branch engages every block,
-while the LOSSY damped predicate must forbid in-horizon boundaries
-entirely — per-group boundary phases are spread uniformly, so at scale
-the whole-batch predicate honestly rejects and the composed run times
-the general damped wave path (the printed warning says so; a per-group
-hybrid split for damped configs is ROADMAP work).
+check-quorum boundary passes so the fused branch engages every block;
+under LOSS the boundary bound is PER GROUP (ISSUE 11 —
+kernels.cq_boundary_safe lossy=, loss-free groups keep the saturation
+proof) and the composed run rides the per-group hybrid split
+(pallas_step.hybrid_multi_round with_chaos): only the groups whose
+boundary actually falls inside the horizon take the general wave path
+each block, and the JSON line's measured fused_frac says exactly how
+much fused coverage the run got.  (--health with the composed config
+still uses the whole-batch dispatcher — the hybrid split does not
+thread health planes.)
 
 Perf-regression gate (docs/PERF.md):
 
@@ -103,6 +118,27 @@ reconfig churn) measured end-to-end:
                   --check like every other series.
   --reconfig-out F  also write the scenario-summary JSON to F (the CI
                   artifact).
+
+Production split-fused mode (ISSUE 11) replaces the steady bench:
+
+  --prod-fused F  run the PRODUCTION configuration — health + counters +
+                  check-quorum + pre-vote + the chaos overlay + the
+                  multi-op ReconfigPlan from F ({"reconfig":...,
+                  "chaos":...}) — through the split-horizon runner
+                  (reconfig.make_split_runner): fused steady blocks
+                  between the op windows, general rounds inside them.
+                  The JSON line carries the scenario summary, the
+                  measured fused_frac (PR 10's unsplit runner fuses 0%
+                  of this configuration), and gates under the
+                  `raft_prod_fused_ticks_per_sec` metric key.
+  --prod-out F    also write the scenario-summary JSON to F.
+  --split-k N     fused block length (default 8).
+  --split-window N  general rounds planned around each op (default 4).
+
+Baseline entries carrying `"retired": true` (e.g. the pre-fusion
+wave-replay `_cq` series) are historical anchors: --check skips them
+with a `retired-baseline` notice instead of gating on them, and
+--update-baseline refuses to overwrite them.
 """
 
 import argparse
@@ -116,6 +152,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.metrics import Registry
+
 
 G = 100_000
 P = 5
@@ -125,6 +163,34 @@ REPS = 5
 SPREAD_FLAG_PCT = 20.0
 ANCHOR_GROUPS = 4096
 ANCHOR_ROUNDS = 60
+
+# Bench-process metrics registry (raft_tpu.metrics, zero-dep): the
+# measured fused-kernel coverage folds in here as
+# `multiraft_fused_rounds_total` so an embedding scraping the bench
+# process sees the same number the JSON line carries.
+METRICS = Registry()
+
+
+def fused_fields(fused_rounds: int, total_rounds: int) -> dict:
+    """The measured fused-fraction fields EVERY bench JSON line carries
+    (ISSUE 11).  Units are GROUP-rounds — one group advancing one
+    protocol round; a whole-batch fused block of k rounds at G groups
+    counts k*G — so per-group dispatchers (hybrid splits) report honest
+    partial coverage.  `fused_frac` = fused_rounds / total_rounds is the
+    gated claim: "the production config stays fused" is this number, not
+    a log line.  Also folds the count into the module METRICS registry as
+    the `multiraft_fused_rounds_total` counter."""
+    METRICS.counter(
+        "multiraft_fused_rounds_total",
+        "fused-kernel group-rounds executed in bench timed regions",
+    ).inc(int(fused_rounds))
+    return {
+        "fused_rounds": int(fused_rounds),
+        "total_rounds": int(total_rounds),
+        "fused_frac": (
+            round(fused_rounds / total_rounds, 4) if total_rounds else 0.0
+        ),
+    }
 
 
 def rep_stats(samples) -> dict:
@@ -193,23 +259,38 @@ def bench_device(
     # semantics; see raft_tpu/multiraft/pallas_step.py).  With --health the
     # per-group health planes ride through both branches
     # (fast_multi_round(..., with_health=True)); with --lossy both branches
-    # additionally thread the link plane + in-kernel loss draws.
+    # additionally thread the link plane + in-kernel loss draws.  The
+    # composed --lossy --check-quorum configuration (without --health)
+    # rides the PER-GROUP hybrid split (ISSUE 11): spread check-quorum
+    # boundary phases cost only the boundary-crossing groups, not the
+    # batch.  Every dispatcher threads the fused group-round accumulator
+    # (count_fused) so the JSON line's fused_frac is measured, not
+    # assumed.
     K = 32
-    kstep = pallas_step.fast_multi_round(
-        cfg, k=K, with_health=health, interpret=interpret, with_chaos=chaos
-    )
+    use_hybrid = chaos and check_quorum and not health
+    if use_hybrid:
+        kstep = pallas_step.hybrid_multi_round(
+            cfg, k=K, with_chaos=True, interpret=interpret,
+            count_fused=True,
+        )
+    else:
+        kstep = pallas_step.fast_multi_round(
+            cfg, k=K, with_health=health, interpret=interpret,
+            with_chaos=chaos, count_fused=True,
+        )
     full = jax.jit(functools.partial(sim.step, cfg))
     hstate = sim.init_health(cfg) if health else None
 
-    def block_step(s, h, rb):
+    def block_step(s, h, rb, fz):
         """One K-round fused-dispatch block at absolute round rb."""
         args = (s, crashed, append)
         if chaos:
             args = args + (link, loss, rb)
         if health:
-            out = kstep(*args, h)
-            return out[0], out[1]
-        return kstep(*args), h
+            s2, h2, fz = kstep(*args, h, fz)
+            return s2, h2, fz
+        out, fz = kstep(*args, fz)
+        return out, h, fz
 
     # The scan carry holds the optional recent_active plane bit-packed
     # 32:1 along G (sim.pack_ra_carry — the ISSUE 8 packed-carry form);
@@ -217,42 +298,42 @@ def bench_device(
     # unchanged.
     if health:
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def multi_round_h(st, ra, h, rb):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def multi_round_h(st, ra, h, fused, rb):
             def body(carry, i):
-                s, raw, hh = carry
-                s, hh = block_step(
-                    sim.unpack_ra_carry(s, raw), hh, rb + i * K
+                s, raw, hh, fz = carry
+                s, hh, fz = block_step(
+                    sim.unpack_ra_carry(s, raw), hh, rb + i * K, fz
                 )
                 s, raw = sim.pack_ra_carry(s)
-                return (s, raw, hh), ()
+                return (s, raw, hh, fz), ()
 
             carry, _ = jax.lax.scan(
-                body, (st, ra, h),
+                body, (st, ra, h, fused),
                 jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32),
             )
             return carry
 
     else:
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def multi_round(st, ra, rb):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def multi_round(st, ra, fused, rb):
             def body(carry, i):
-                s, raw = carry
-                s = block_step(
-                    sim.unpack_ra_carry(s, raw), None, rb + i * K
-                )[0]
-                return sim.pack_ra_carry(s), ()
+                s, raw, fz = carry
+                s, _, fz = block_step(
+                    sim.unpack_ra_carry(s, raw), None, rb + i * K, fz
+                )
+                return sim.pack_ra_carry(s) + (fz,), ()
 
             carry, _ = jax.lax.scan(
-                body, (st, ra),
+                body, (st, ra, fused),
                 jnp.arange(ROUNDS_PER_SCAN // K, dtype=jnp.int32),
             )
             return carry
 
     round_no = 0
 
-    def advance(stp, ra, h):
+    def advance(stp, ra, h, fused):
         """One donated scan segment over the PACKED carry: the bit-packed
         recent_active words stay packed between segments, so the timed
         loop never materializes the bool[P, P, G] plane — unpacking is
@@ -261,10 +342,10 @@ def bench_device(
         rb = jnp.int32(round_no)
         round_no += ROUNDS_PER_SCAN
         if health:
-            stp, ra, h = multi_round_h(stp, ra, h, rb)
+            stp, ra, h, fused = multi_round_h(stp, ra, h, fused, rb)
         else:
-            stp, ra = multi_round(stp, ra, rb)
-        return stp, ra, h
+            stp, ra, fused = multi_round(stp, ra, fused, rb)
+        return stp, ra, h, fused
 
     # Warm up: compile + let the election storm settle into steady state
     # (the chaos/damped configs' longer election_tick needs a longer
@@ -274,18 +355,22 @@ def bench_device(
         state = full(state, crashed, append)
     round_no = settle
     stp, ra = sim.pack_ra_carry(state)
-    stp, ra, hstate = advance(stp, ra, hstate)
+    stp, ra, hstate, _warm_fused = advance(stp, ra, hstate, jnp.int32(0))
     jax.block_until_ready(stp)
-    if chaos or check_quorum:
+    if (chaos or check_quorum) and not use_hybrid:
         # Honesty check: the timed region must actually ride the fused
         # kernel — a rejected predicate would silently bench the general
-        # fallback instead of the fast path being labeled.  The unpack
-        # happens here, OUTSIDE the timed region; `state`'s buffers alias
-        # the carry and are donated away by the next advance, so it must
-        # not be read after the timed loop starts.
+        # fallback instead of the fast path being labeled.  (The hybrid
+        # split needs no warning: its coverage IS the measured fused_frac
+        # in the JSON line.)  The unpack happens here, OUTSIDE the timed
+        # region; `state`'s buffers alias the carry and are donated away
+        # by the next advance, so it must not be read after the timed
+        # loop starts.
         state = sim.unpack_ra_carry(stp, ra)
         pred = bool(
-            pallas_step.steady_predicate(cfg, state, crashed, K, link)
+            pallas_step.steady_predicate(
+                cfg, state, crashed, K, link, loss_rate=loss
+            )
         )
         if not pred:
             print(
@@ -298,6 +383,8 @@ def bench_device(
     rounds = (ROUNDS_PER_SCAN // K) * K * SCANS
     ticks = groups * rounds
     samples = []
+    fused_total = 0
+    fused = jnp.int32(0)  # re-zeroed: the warm-up segment doesn't count
     if profile_dir:
         from raft_tpu import profiling
 
@@ -306,9 +393,25 @@ def bench_device(
         for _ in range(reps):
             t0 = time.perf_counter()
             for _ in range(SCANS):
-                stp, ra, hstate = advance(stp, ra, hstate)
+                stp, ra, hstate, fused = advance(stp, ra, hstate, fused)
             jax.block_until_ready(stp)
             samples.append(ticks / (time.perf_counter() - t0))
+            # Per-rep drain of the int32 group-round accumulator — one
+            # rep accrues groups x rounds (= 384) group-rounds, within
+            # int32 up to ~5.5M groups; the carry is already synced, so
+            # this fetch costs the timed region nothing.
+            got = int(jax.device_get(fused))
+            if got < 0:
+                # The same v<0 wrap backstop as the counter drain: a
+                # batch large enough to wrap the per-rep window must fail
+                # loudly, not report a garbage fused_frac.
+                raise RuntimeError(
+                    "fused group-round accumulator wrapped int32 within "
+                    "one rep (groups x rounds_per_rep >= 2**31); reduce "
+                    "--groups"
+                )
+            fused_total += got
+            fused = jnp.int32(0)
     finally:
         if profile_dir:
             profiling.stop_trace()
@@ -334,7 +437,10 @@ def bench_device(
             json.dump(
                 HealthMonitor.summary_dict(counts, hist, ids, scores), f
             )
-    return rep_stats(samples)
+    return {
+        **rep_stats(samples),
+        **fused_fields(fused_total, groups * rounds * reps),
+    }
 
 
 def bench_chaos(
@@ -385,7 +491,13 @@ def bench_chaos(
             file=sys.stderr,
         )
         raise SystemExit(2)
-    return {"report": report, **rep_stats(samples)}
+    # The chaos runner is the per-round link-gated scan — no fused blocks
+    # by construction; the honest fused_frac is 0.
+    return {
+        "report": report,
+        **rep_stats(samples),
+        **fused_fields(0, groups * plan.n_rounds * reps),
+    }
 
 
 def bench_reconfig(
@@ -465,7 +577,131 @@ def bench_reconfig(
             file=sys.stderr,
         )
         raise SystemExit(2)
-    return {"report": report, **rep_stats(samples)}
+    # make_runner is the unsplit per-round scan (--prod-fused is the
+    # split-horizon mode); the honest fused_frac here is 0.
+    return {
+        "report": report,
+        **rep_stats(samples),
+        **fused_fields(0, groups * plan.n_rounds * reps),
+    }
+
+
+def bench_prod_fused(
+    plan_path: str,
+    groups: int,
+    reps: int,
+    prod_out: str = "",
+    k: int = 8,
+    window: int = 4,
+) -> dict:
+    """The PRODUCTION configuration, measured honestly fused (ISSUE 11):
+    health + counters + chaos overlay + check-quorum + pre-vote + a
+    multi-op ReconfigPlan, executed through the split-horizon runner
+    (reconfig.make_split_runner) — the steady stretches between op
+    windows ride the fused Pallas kernel in k-round blocks, the op
+    propose/gate/apply rounds and runtime-rejected blocks run the general
+    damped wave path — reporting ticks/sec AND the measured fused
+    fraction.  PR 10's unsplit runner fuses 0% of this configuration;
+    the acceptance floor is fused_frac >= 0.8 (--fused-floor in CI).
+
+    Leaders settle OUTSIDE the timed region (3x election_tick general
+    rounds from the plan's bootstrap masks — the boot storm is not the
+    production regime being measured); each rep replays the plan from a
+    copy of the settled state because the runner donates its carry and
+    plans apply absolute masks."""
+    from raft_tpu.multiraft import chaos, kernels, reconfig, sim
+    from raft_tpu.multiraft.health import HealthMonitor
+    from raft_tpu.multiraft.kernels import HP_SINCE_COMMIT
+    from raft_tpu.multiraft.sim import SimConfig
+
+    with open(plan_path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    chaos_doc = doc.get("chaos")
+    plan = reconfig.plan_from_dict(doc.get("reconfig", doc))
+    # election_tick=64: the damped free-running timer bound must clear
+    # the k-round fused horizon (docs/PERF.md), same regime as --lossy.
+    cfg = SimConfig(
+        n_groups=groups, n_peers=plan.n_peers, election_tick=64,
+        collect_health=True, collect_counters=True,
+        check_quorum=True, pre_vote=True,
+    )
+    compiled = reconfig.compile_plan(plan, groups)
+    chaos_compiled = (
+        None
+        if chaos_doc is None
+        else chaos.compile_plan(chaos.plan_from_dict(chaos_doc), groups)
+    )
+    interpret = jax.default_backend() == "cpu"
+    runner = reconfig.make_split_runner(
+        cfg, compiled, chaos_compiled, k=k, window=window,
+        with_counters=True, interpret=interpret,
+    )
+    step = jax.jit(functools.partial(sim.step, cfg))
+    crashed0 = jnp.zeros((plan.n_peers, groups), bool)
+    settle_append = jnp.ones((groups,), jnp.int32)
+    st0 = sim.init_state(cfg, *reconfig.initial_masks(plan, groups))
+    for _ in range(3 * cfg.election_tick):
+        st0 = step(st0, crashed0, settle_append)
+    jax.block_until_ready(st0)
+
+    def fresh():
+        # A copy per rep: the runner donates the carry, st0 is the keeper.
+        st = jax.tree.map(jnp.copy, st0)
+        return (
+            st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
+            kernels.zero_counters(),
+        )
+
+    out = runner(*fresh())  # compile + first run
+    jax.block_until_ready(out[3])
+    samples = []
+    fused_total = 0
+    for _ in range(reps):
+        st, hl, rst, ctrs = fresh()
+        jax.block_until_ready((st, hl, rst))
+        t0 = time.perf_counter()
+        st, hl, rst, stats, rstats, safety, fused, ctrs = runner(
+            st, hl, rst, ctrs
+        )
+        jax.block_until_ready(stats)
+        samples.append(
+            groups * plan.n_rounds / (time.perf_counter() - t0)
+        )
+        fused_total += int(jax.device_get(fused))
+    stats_h, rstats_h, safety_h, om_h, since_h = jax.device_get(
+        (stats, rstats, safety, st.outgoing_mask,
+         hl.planes[HP_SINCE_COMMIT])
+    )
+    n_stuck, worst = HealthMonitor.reconfig_stall_groups(
+        om_h, since_h, cfg.election_tick
+    )
+    report = HealthMonitor.reconfig_report(
+        stats_h, rstats_h, safety_h, plan.n_rounds, n_stuck, worst,
+    )
+    report["plan"] = plan.name
+    report["groups"] = groups
+    report["peers"] = plan.n_peers
+    report["phases"] = len(plan.phases)
+    report["chaos_overlay"] = chaos_doc is not None
+    report["segments"] = [
+        {"start": s.start, "rounds": s.rounds, "fused": s.fused}
+        for s in runner.segments
+    ]
+    if prod_out:
+        with open(prod_out, "w") as f:
+            json.dump(report, f)
+    if any(report["safety"].values()):
+        print(
+            f"ERROR: prod-fused plan {plan.name} violated safety "
+            f"invariants: {report['safety']}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return {
+        "report": report,
+        **rep_stats(samples),
+        **fused_fields(fused_total, groups * plan.n_rounds * reps),
+    }
 
 
 def bench_scalar_anchor(reps: int = REPS) -> dict:
@@ -508,6 +744,15 @@ def check_against_baseline(
     if entry is None:
         verdict["status"] = "no-baseline"
         return True, verdict
+    if entry.get("retired"):
+        # A retired entry is a historical anchor (e.g. the pre-fusion
+        # wave-replay `_cq` series), not a live gate: skip with notice
+        # instead of silently thresholding against a methodology that no
+        # longer exists.
+        verdict["status"] = "retired-baseline"
+        if entry.get("note"):
+            verdict["note"] = entry["note"]
+        return True, verdict
     thr = (
         threshold_pct
         if threshold_pct is not None
@@ -538,6 +783,15 @@ def run_check(args, line) -> None:
             baseline = json.load(f)
     key = check_key(line["metric"], line.get("groups", G))
     if args.update_baseline:
+        if baseline.get(key, {}).get("retired"):
+            print(
+                f"ERROR: baseline entry {key} is marked retired (a "
+                "historical anchor); refusing to overwrite it — remove "
+                "the \"retired\" flag by hand if the series is being "
+                "deliberately revived",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
         if line.get("spread_flagged"):
             # The gate's own validity rule cuts both ways: a >20%-spread
             # run cannot assert a pass, a regression, OR a baseline — a
@@ -609,6 +863,11 @@ def main() -> None:
     ap.add_argument("--chaos-out", default="", metavar="FILE")
     ap.add_argument("--reconfig", default="", metavar="PLAN_JSON")
     ap.add_argument("--reconfig-out", default="", metavar="FILE")
+    ap.add_argument("--prod-fused", default="", metavar="PLAN_JSON")
+    ap.add_argument("--prod-out", default="", metavar="FILE")
+    ap.add_argument("--split-k", type=int, default=8)
+    ap.add_argument("--split-window", type=int, default=4)
+    ap.add_argument("--fused-floor", type=float, default=None)
     ap.add_argument("--check", default="", metavar="BASELINE_JSON")
     ap.add_argument("--check-out", default="", metavar="FILE")
     ap.add_argument("--check-threshold", type=float, default=None)
@@ -631,6 +890,44 @@ def main() -> None:
         # -1.0 is the chaos-off sentinel; any OTHER negative is a typo
         # that would silently bench the plain path under the steady key.
         ap.error("--lossy rate must be in [0, 1]")
+    if args.prod_fused and (args.chaos or args.reconfig):
+        ap.error("--prod-fused is its own mode (overlay chaos via the "
+                 "plan file's \"chaos\" key)")
+    if args.prod_out and not args.prod_fused:
+        ap.error("--prod-out requires --prod-fused")
+
+    def enforce_fused_floor(line):
+        if args.fused_floor is None:
+            return
+        if line.get("fused_frac", 0.0) < args.fused_floor:
+            print(
+                f"ERROR: fused_frac {line.get('fused_frac')} is below "
+                f"the --fused-floor {args.fused_floor}: the production "
+                "configuration fell off the fused kernel",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+
+    if args.prod_fused:
+        prod_stats = bench_prod_fused(
+            args.prod_fused, args.groups, args.reps, args.prod_out,
+            k=args.split_k, window=args.split_window,
+        )
+        warn_spread("prod-fused device", prod_stats)
+        line = {
+            "metric": "raft_prod_fused_ticks_per_sec",
+            "value": prod_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            "check_quorum": True,
+            "pre_vote": True,
+            **prod_stats,
+        }
+        print(json.dumps(line))
+        enforce_fused_floor(line)
+        if args.check:
+            run_check(args, line)
+        return
 
     if args.reconfig:
         reconfig_stats = bench_reconfig(
@@ -727,6 +1024,7 @@ def main() -> None:
     if args.check_quorum:
         line["check_quorum"] = True
     print(json.dumps(line))
+    enforce_fused_floor(line)
     if args.check:
         run_check(args, line)
 

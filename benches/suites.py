@@ -181,6 +181,7 @@ def bench_baseline_configs(results, quick):
         results.append(bench_read_barrier())
         results.append(bench_fused_instrumented())
         results.append(bench_fused_damped())
+        results.append(bench_prod_fused_split())
 
 
 def bench_fused_instrumented(G=100_000, P=5):
@@ -320,6 +321,32 @@ def bench_fused_damped(G=100_000, P=5):
     return (
         f"config3cq: {G // 1000}k x {P} fused health+ctrs+cq+pv",
         G * blocks * k / dt / 1e6,
+        "M ticks/s",
+    )
+
+
+def bench_prod_fused_split(G=100_000):
+    """config4f: the FULL production configuration under membership churn
+    (ISSUE 11) — health + counters + check-quorum + pre-vote + a chaos
+    overlay + the 3-op prod_fused ReconfigPlan — through the
+    split-horizon runner, the configuration PR 10's unsplit scan fuses
+    0% of.  Delegates to bench.bench_prod_fused so the production regime
+    (SimConfig, settle, split knobs) is defined ONCE; the row label
+    carries the measured fused fraction so the table can't quietly
+    report a general-path number as fused."""
+    import os
+
+    import bench
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "reconfig", "prod_fused.json",
+    )
+    stats = bench.bench_prod_fused(path, groups=G, reps=2)
+    return (
+        f"config4f: {G // 1000}k x {stats['report']['peers']} split-fused "
+        f"prod churn (fused_frac {stats['fused_frac']:.2f})",
+        stats["median"] / 1e6,
         "M ticks/s",
     )
 

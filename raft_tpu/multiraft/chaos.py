@@ -286,13 +286,16 @@ def compile_plan(plan: ChaosPlan, n_groups: int) -> CompiledChaos:
     )
 
 
-def schedule_masks(
+def schedule_planes(
     compiled: CompiledChaos,
     round_idx: jnp.ndarray,  # gc: int32[]
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Device-side (link, crashed, append) for one round of the schedule:
-    gather the round's (packed) phase row, unpack it on device, and knock
-    out the seeded loss sample."""
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device-side (base_link, loss_rate, crashed, append) for one round:
+    the round's phase row gathered and unpacked WITHOUT the loss sample
+    knocked out.  schedule_masks is the per-round consumer; the split
+    fused dispatch (reconfig.make_split_runner) needs the base plane for
+    its steady predicate and the raw rates for the in-kernel draw — both
+    constant across a phase, so one gather covers a whole fused block."""
     P = compiled.n_peers
     G = compiled.append.shape[1]
     ph = compiled.phase_of_round[round_idx]
@@ -303,8 +306,19 @@ def schedule_masks(
         P, P, G
     )
     crashed = kernels.unpack_bits(compiled.crashed_packed[ph], P)
+    return link, loss, crashed, compiled.append[ph]
+
+
+def schedule_masks(
+    compiled: CompiledChaos,
+    round_idx: jnp.ndarray,  # gc: int32[]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device-side (link, crashed, append) for one round of the schedule:
+    gather the round's (packed) phase row, unpack it on device, and knock
+    out the seeded loss sample."""
+    link, loss, crashed, append = schedule_planes(compiled, round_idx)
     drop = kernels.link_loss_draw(round_idx, loss)
-    return link & ~drop, crashed, compiled.append[ph]
+    return link & ~drop, crashed, append
 
 
 # --- host twins (the ChaosOracle side; must stay bit-identical) -----------

@@ -294,12 +294,18 @@ LOSS_SCALE = 10_000  # loss rates are int32 fixed-point per-ten-thousand
 def link_loss_draw(
     round_idx: jnp.ndarray,  # gc: int32[]
     loss_rate: jnp.ndarray,  # gc: int32[P, P, G]
+    group_ids: Optional[jnp.ndarray] = None,  # gc: int32[G]
 ) -> jnp.ndarray:
     """Seeded per-link message-loss sample for one protocol round.
 
     round_idx: int32 scalar, the round number (the replay key).
     loss_rate: int32[P, P, G] per-directed-link loss probability in units
                of 1/LOSS_SCALE (0 = lossless, LOSS_SCALE = always down).
+    group_ids: optional int32[G] GLOBAL group ids when loss_rate is a
+               gathered sub-batch (pallas_step's per-group storm split):
+               the (round, src, dst, group) PRNG key must keep drawing
+               from each group's global stream, exactly like sim.step's
+               group_ids= keeps the timeout PRNG global.
 
     Returns bool[P, P, G]: True where the (src, dst, group) link drops all
     messages this round.  The draw is a counter PRNG keyed
@@ -310,7 +316,10 @@ def link_loss_draw(
     """
     P = loss_rate.shape[0]
     G = loss_rate.shape[2]
-    g = jnp.arange(G, dtype=jnp.uint32)[None, None, :]
+    if group_ids is None:
+        g = jnp.arange(G, dtype=jnp.uint32)[None, None, :]
+    else:
+        g = group_ids.astype(jnp.uint32)[None, None, :]
     s = jnp.arange(P, dtype=jnp.uint32)[:, None, None]
     d = jnp.arange(P, dtype=jnp.uint32)[None, :, None]
     lane = s * jnp.uint32(P) + d + jnp.uint32(1)
@@ -731,6 +740,7 @@ def cq_boundary_safe(
     election_elapsed: jnp.ndarray,  # gc: int32[P, G]
     horizon: int,
     election_tick: int,
+    lossy: Optional[jnp.ndarray] = None,  # gc: bool[G]
 ) -> jnp.ndarray:
     """bool[G]: every check-quorum boundary that CAN fire within `horizon`
     rounds provably passes — the damping half of the fused steady
@@ -754,9 +764,15 @@ def cq_boundary_safe(
         timer runs free and its row receives no acks, so its boundary
         outcome is its carried row — conservatively excluded).
 
-    Lossy (chaos) horizons cannot prove re-saturation and use the fully
-    conservative no-boundary-at-all bound instead (steady_mask inlines
-    it); this kernel is the lossless branch only.
+    `lossy` (optional bool[G]) marks groups whose heartbeat traffic may be
+    DROPPED this horizon (a nonzero per-link loss rate anywhere in the
+    group): loss breaks the re-saturation argument, so those groups fall
+    back per group to the fully conservative no-boundary bound — NO
+    role-leader (alive or crashed stale) may reach its election-timeout
+    boundary inside the horizon at all.  None keeps the historical
+    all-lossless behavior (the pre-split callers' graphs are unchanged).
+    This is the PER-GROUP bound: a batch mixing lossy and loss-free
+    groups no longer collapses to the weakest group's condition.
     """
     alive = ~crashed
     is_lead_alive = (state == ROLE_LEADER) & alive
@@ -780,7 +796,19 @@ def cq_boundary_safe(
         ),
         axis=0,
     )
-    return lead_ok & alive_quorum & stale_ok
+    lossless_ok = lead_ok & alive_quorum & stale_ok
+    if lossy is None:
+        return lossless_ok
+    role_lead = state == ROLE_LEADER
+    no_boundary = jnp.all(
+        jnp.where(
+            role_lead,
+            election_elapsed + jnp.int32(horizon) < jnp.int32(election_tick),
+            True,
+        ),
+        axis=0,
+    )
+    return jnp.where(lossy, no_boundary, lossless_ok)
 
 
 def timeout_draw(

@@ -1358,6 +1358,7 @@ def steady_mask(
     horizon: int = 1,
     link: Optional[jnp.ndarray] = None,
     reconfig_pending: Optional[jnp.ndarray] = None,
+    loss_rate: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """bool[G]: per-group steady invariant for the next `horizon` rounds —
     no election timer can fire, exactly one alive leader, every alive peer
@@ -1392,7 +1393,16 @@ def steady_mask(
     NOW, the alive voters re-saturate it each heartbeat interval, and no
     crashed stale leader reaches its boundary); the lossy (`link=`)
     branch cannot prove re-saturation and requires that NO role-leader
-    reaches its boundary at all."""
+    reaches its boundary at all.
+
+    `loss_rate` (optional int32[P, P, G], only meaningful with `link`)
+    makes the lossy check-quorum bound PER GROUP (ISSUE 11): a group
+    whose loss rates are all zero delivers every heartbeat a healed link
+    plane carries, so the LOSSLESS saturation argument
+    (kernels.cq_boundary_safe) applies to it even on a chaos horizon;
+    only groups with a nonzero rate anywhere keep the conservative
+    no-boundary-in-horizon bound.  None preserves the historical
+    all-groups conservative form byte-for-byte."""
     damped = cfg.check_quorum or cfg.pre_vote
     if damped and cfg.election_tick <= cfg.heartbeat_tick:
         # The check-quorum saturation argument needs one full heartbeat
@@ -1473,6 +1483,22 @@ def steady_mask(
                 horizon,
                 cfg.election_tick,
             )
+        elif loss_rate is not None:
+            # Per-group lossy bound (ISSUE 11): only groups with a
+            # nonzero loss rate anywhere need the conservative
+            # no-boundary form; loss-free groups keep the lossless
+            # saturation proof.
+            ok = ok & kernels_mod.cq_boundary_safe(
+                st.recent_active,
+                st.voter_mask,
+                st.outgoing_mask,
+                st.state,
+                crashed,
+                st.election_elapsed,
+                horizon,
+                cfg.election_tick,
+                lossy=jnp.any(loss_rate != 0, axis=(0, 1)),
+            )
         else:
             role_lead = st.state == ROLE_LEADER
             no_boundary = jnp.all(
@@ -1494,10 +1520,13 @@ def steady_predicate(
     crashed: jnp.ndarray,
     horizon: int = 1,
     link: Optional[jnp.ndarray] = None,
+    loss_rate: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """True iff EVERY group satisfies the steady invariant (see
     steady_mask)."""
-    return jnp.all(steady_mask(cfg, st, crashed, horizon, link))
+    return jnp.all(
+        steady_mask(cfg, st, crashed, horizon, link, loss_rate=loss_rate)
+    )
 
 
 def fast_step(cfg: SimConfig, with_health: bool = False):
@@ -1540,6 +1569,7 @@ def fast_multi_round(
     interpret: bool = False,
     with_chaos: bool = False,
     with_counters: bool = False,
+    count_fused: bool = False,
 ):
     """Dispatcher advancing k protocol rounds per call (same crashed/append
     every round): the k-fused pallas kernel when provably steady for the
@@ -1560,7 +1590,18 @@ def fast_multi_round(
     kernel runs when the steady invariant holds AND the link plane is
     fully healed among alive peers (loss is folded in-kernel); otherwise k
     sequential sim.step(link=link & ~loss_draw) rounds run — bit-identical
-    either way (tests/test_pallas_step.py)."""
+    either way (tests/test_pallas_step.py).  The chaos predicate feeds the
+    loss plane into steady_mask's PER-GROUP check-quorum boundary bound
+    (ISSUE 11): loss-free groups keep the lossless saturation proof, so a
+    zero-rate chaos overlay no longer forbids in-horizon boundaries.
+
+    With `count_fused`, the fn takes ONE extra trailing int32[] argument —
+    the fused GROUP-round accumulator — and returns it (appended last)
+    incremented by k * n_groups when the fused branch ran, unchanged
+    otherwise.  This is the measured fused-fraction metric (bench.py
+    `fused_frac`): an exact in-graph count, not a log line.  int32 bound:
+    the caller keeps total group-rounds below 2**31 (bench.py drains it
+    per run).  count_fused=False leaves every existing graph unchanged."""
     pallas_fn = steady_round(
         cfg,
         rounds=k,
@@ -1621,14 +1662,28 @@ def fast_multi_round(
             )
 
         def fn_general(st, crashed, append_n, *rest):
+            if count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                fused = rest[-1]
+                rest = rest[:-1]
             link = rest[0] if chaos_at is not None else None
-            pred = steady_predicate(cfg, st, crashed, horizon=k, link=link)
-            return jax.lax.cond(
+            loss = rest[1] if chaos_at is not None else None
+            pred = steady_predicate(
+                cfg, st, crashed, horizon=k, link=link, loss_rate=loss
+            )
+            out = jax.lax.cond(
                 pred,
                 fast,
                 slow_general,
                 (st, crashed, append_n) + tuple(rest),
             )
+            if not count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                return out
+            fused = fused + jnp.where(
+                pred, jnp.int32(k * cfg.n_groups), jnp.int32(0)
+            )
+            if n_extra:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                return tuple(out) + (fused,)
+            return out, fused
 
         return fn_general
 
@@ -1644,14 +1699,20 @@ def fast_multi_round(
 
             return jax.lax.scan(body, (st, health), None, length=k)[0]
 
-        def fn_health(st: SimState, crashed, append_n, health):
+        def fn_health(st: SimState, crashed, append_n, health, *acc):
             pred = steady_predicate(cfg, st, crashed, horizon=k)
-            return jax.lax.cond(
+            out = jax.lax.cond(
                 pred,
                 lambda args: pallas_fn(*args),
                 slow_health,
                 (st, crashed, append_n, health),
             )
+            if not count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                return out
+            fused = acc[0] + jnp.where(
+                pred, jnp.int32(k * cfg.n_groups), jnp.int32(0)
+            )
+            return tuple(out) + (fused,)
 
         return fn_health
 
@@ -1663,19 +1724,32 @@ def fast_multi_round(
 
         return jax.lax.scan(body, st, None, length=k)[0]
 
-    def fn(st: SimState, crashed, append_n) -> SimState:
+    def fn(st: SimState, crashed, append_n, *acc):
         pred = steady_predicate(cfg, st, crashed, horizon=k)
-        return jax.lax.cond(
+        out = jax.lax.cond(
             pred,
             lambda args: pallas_fn(*args),
             slow,
             (st, crashed, append_n),
         )
+        if not count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            return out
+        fused = acc[0] + jnp.where(
+            pred, jnp.int32(k * cfg.n_groups), jnp.int32(0)
+        )
+        return out, fused
 
     return fn
 
 
-def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
+def hybrid_multi_round(
+    cfg: SimConfig,
+    k: int = 16,
+    storm_slots: int = 4096,
+    with_chaos: bool = False,
+    interpret: bool = False,
+    count_fused: bool = False,
+):
     """k protocol rounds with a PER-GROUP steady/slow split.
 
     fast_multi_round drops the ENTIRE batch to k sequential general steps
@@ -1694,17 +1768,54 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
     `storm_slots` groups are non-steady (mass storms: elections at boot,
     correlated failures).
 
+    With `with_chaos` (ISSUE 11), the fn signature grows (link, loss_rate,
+    round_base) after append_n — the chaos fault surface — and the split
+    becomes the per-group answer to the lossy damped boundary problem:
+    steady_mask's PER-GROUP check-quorum bound (loss-aware via
+    `loss_rate`) decides each group, so only the groups whose boundary
+    actually falls inside the horizon (or whose links are faulted) take
+    the general branch, while the rest of the batch stays on the fused
+    chaos/damped kernel.  Spread boundary phases no longer collapse the
+    whole batch to the wave path.  The storm sub-batch passes its global
+    group ids into both the timeout PRNG and the per-link loss PRNG
+    (kernels.link_loss_draw group_ids=), so every group's seeded streams
+    are unchanged — bit-identical to k sequential
+    sim.step(link=link & ~loss_draw) rounds.
+
+    With `count_fused`, one extra trailing int32[] accumulator rides the
+    signature and returns incremented by k * (fused group count) — the
+    per-group fused-fraction metric (group-rounds, exact).
+
     Health planes are NOT threaded here (use fast_multi_round(...,
     with_health=True) or the general step): the storm split would need a
     per-sub-batch window-position fork that the closed-form steady fold
     cannot express."""
     G = cfg.n_groups
     S = min(storm_slots, G)
-    pallas_fn = steady_round(cfg, rounds=k)
+    pallas_fn = steady_round(
+        cfg, rounds=k, interpret=interpret, with_chaos=with_chaos
+    )
     sub_cfg = cfg._replace(n_groups=S)
 
+    def group_mask(st, crashed, link, loss):
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            return steady_mask(
+                cfg, st, crashed, horizon=k, link=link, loss_rate=loss
+            )
+        return steady_mask(cfg, st, crashed, horizon=k)
+
     def slow(args):
-        st, crashed, append_n = args
+        st, crashed, append_n = args[:3]
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            link, loss, rb = args[3:6]
+
+            def body_c(s, r):
+                lk = link & ~kernels_mod.link_loss_draw(rb + r, loss)
+                return sim_mod.step(cfg, s, crashed, append_n, link=lk), ()
+
+            return jax.lax.scan(
+                body_c, st, jnp.arange(k, dtype=jnp.int32)
+            )[0]
 
         def body(s, _):
             return sim_mod.step(cfg, s, crashed, append_n), ()
@@ -1712,8 +1823,11 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
         return jax.lax.scan(body, st, None, length=k)[0]
 
     def hybrid(args):
-        st, crashed, append_n = args
-        mask = steady_mask(cfg, st, crashed, horizon=k)  # [G] True = steady
+        st, crashed, append_n = args[:3]
+        link = loss = rb = None
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            link, loss, rb = args[3:6]
+        mask = group_mask(st, crashed, link, loss)  # [G] True = steady
         # Stable sort: storm groups (False=0) first, original order kept.
         order = jnp.argsort(mask.astype(jnp.int8), stable=True)
         idx = order[:S]  # [S] global ids of the storm groups (+ padding)
@@ -1723,14 +1837,40 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
         sub_crashed = crashed[:, idx]
         sub_append = append_n[idx]
 
-        def body(s, _):
-            return (
-                sim_mod.step(sub_cfg, s, sub_crashed, sub_append, group_ids=idx),
-                (),
-            )
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            sub_link = link[:, :, idx]
+            sub_loss = loss[:, :, idx]
 
-        sub_out = jax.lax.scan(body, sub, None, length=k)[0]
-        fast_out = pallas_fn(st, crashed, append_n)
+            def body_c(s, r):
+                # Global group ids key BOTH seeded streams (timeouts and
+                # per-link loss), so the gathered replay is bit-identical.
+                lk = sub_link & ~kernels_mod.link_loss_draw(
+                    rb + r, sub_loss, group_ids=idx.astype(jnp.int32)
+                )
+                return (
+                    sim_mod.step(
+                        sub_cfg, s, sub_crashed, sub_append,
+                        group_ids=idx, link=lk,
+                    ),
+                    (),
+                )
+
+            sub_out = jax.lax.scan(
+                body_c, sub, jnp.arange(k, dtype=jnp.int32)
+            )[0]
+            fast_out = pallas_fn(st, crashed, append_n, loss, rb)
+        else:
+
+            def body(s, _):
+                return (
+                    sim_mod.step(
+                        sub_cfg, s, sub_crashed, sub_append, group_ids=idx
+                    ),
+                    (),
+                )
+
+            sub_out = jax.lax.scan(body, sub, None, length=k)[0]
+            fast_out = pallas_fn(st, crashed, append_n)
 
         def merge(fast, subv):
             gathered = jnp.where(take_sub, subv, fast[..., idx])
@@ -1738,19 +1878,35 @@ def hybrid_multi_round(cfg: SimConfig, k: int = 16, storm_slots: int = 4096):
 
         return jax.tree.map(merge, fast_out, sub_out)
 
-    def fn(st: SimState, crashed, append_n) -> SimState:
+    def pure(args):
+        if with_chaos:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            return pallas_fn(args[0], args[1], args[2], args[4], args[5])
+        return pallas_fn(*args)
+
+    def fn(st: SimState, crashed, append_n, *rest) -> SimState:
+        if count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            fused = rest[-1]
+            rest = rest[:-1]
+        link = rest[0] if with_chaos else None
+        loss = rest[1] if with_chaos else None
         n_storm = jnp.sum(
-            ~steady_mask(cfg, st, crashed, horizon=k)
+            ~group_mask(st, crashed, link, loss)
         ).astype(jnp.int32)
         # Three-way dispatch: the all-steady case takes the PURE fused
         # kernel (no argsort/gather/sub-batch overhead — the common case
         # must cost exactly what fast_multi_round costs), sparse storms the
         # gathered split, mass storms the whole-batch general fallback.
-        return jax.lax.cond(
+        out = jax.lax.cond(
             n_storm == 0,
-            lambda args: pallas_fn(*args),
+            pure,
             lambda args: jax.lax.cond(n_storm <= S, hybrid, slow, args),
-            (st, crashed, append_n),
+            (st, crashed, append_n) + tuple(rest),
         )
+        if not count_fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            return out
+        fused_groups = jnp.where(
+            n_storm <= S, jnp.int32(G) - n_storm, jnp.int32(0)
+        )
+        return out, fused + jnp.int32(k) * fused_groups
 
     return fn

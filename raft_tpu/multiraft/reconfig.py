@@ -82,7 +82,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -594,7 +594,15 @@ def pending_in_horizon(
     """bool[G]: groups with a conf entry in flight OR an op scheduled to
     become eligible within the next `horizon` rounds — the mask
     pallas_step.steady_mask must reject (a fused horizon cannot propose,
-    gate, or apply a conf change)."""
+    gate, or apply a conf change).
+
+    Since ISSUE 11 this per-group runtime check is the GUARD of the
+    split-horizon machinery, not its whole story: `split_plan` is the
+    host-side split-point planner that places the scheduled op rounds in
+    general segments up front (so the common case never pays a rejected
+    fused block), and this mask catches the dynamic tail — an op whose
+    retry chain outlives its planned window keeps its group's fused
+    blocks honestly on the general path until the op applies."""
     start = _gather_op(compiled.op_start, rst.op_ptr)
     has_op = rst.op_ptr < compiled.n_ops
     return (rst.stage > 0) | (
@@ -602,47 +610,227 @@ def pending_in_horizon(
     )
 
 
-def make_runner(
+# --- split-horizon planning (ISSUE 11) --------------------------------------
+
+
+class HorizonSegment(NamedTuple):
+    """One planned stretch of a runner horizon (host-side python ints).
+
+    start:  absolute round index of the segment's first round.
+    rounds: segment length (>= 1).
+    fused:  True = the segment is a whole number of k-round fused-dispatch
+            blocks (each still guarded at runtime by the steady predicate
+            + pending_in_horizon, so the plan is a performance hint, never
+            a correctness assumption); False = per-round general rounds
+            (the op propose/gate/apply windows, phase-cut remainders, and
+            fused spans shorter than one block).
+    """
+
+    start: int
+    rounds: int
+    fused: bool
+
+
+def plan_split_points(
+    n_rounds: int,
+    windows: Sequence[Tuple[int, int]],
+    cuts: Sequence[int] = (),
+    k: int = 8,
+) -> List[HorizonSegment]:
+    """Lower op windows + schedule-phase cuts to an ordered segment list.
+
+    windows: half-open (start, end) GENERAL intervals — where scheduled
+             conf-change ops propose/gate/apply (overlaps are merged).
+    cuts:    round indices a fused block may not span (phase starts: the
+             append workload and fault masks change there, and a fused
+             block needs them constant).
+    k:       fused block length in rounds.
+
+    Returns segments covering [0, n_rounds) exactly, in order.  Fused
+    segments always have rounds % k == 0 — remainders degrade to general
+    segments — and an empty `windows` with no interior cuts yields ONE
+    full fused segment (plus a general remainder when n_rounds % k != 0).
+    """
+    R = int(n_rounds)
+    if R < 1:
+        raise ValueError("n_rounds must be >= 1")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    ivs = sorted(
+        (max(0, int(a)), min(R, int(b)))
+        for a, b in windows
+        if int(b) > 0 and int(a) < R and int(b) > int(a)
+    )
+    merged: List[Tuple[int, int]] = []
+    for a, b in ivs:
+        if merged and a <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], b))
+        else:
+            merged.append((a, b))
+    cutset = sorted({int(c) for c in cuts if 0 < int(c) < R})
+    segs: List[HorizonSegment] = []
+
+    def emit_fused_span(a: int, b: int) -> None:
+        points = [a] + [c for c in cutset if a < c < b] + [b]
+        for lo, hi in zip(points, points[1:]):
+            nb = (hi - lo) // k
+            if nb:
+                segs.append(HorizonSegment(lo, nb * k, True))
+            rem = (hi - lo) - nb * k
+            if rem:
+                segs.append(HorizonSegment(lo + nb * k, rem, False))
+
+    pos = 0
+    for a, b in merged:
+        if a > pos:
+            emit_fused_span(pos, a)
+        segs.append(HorizonSegment(a, b - a, False))
+        pos = b
+    if pos < R:
+        emit_fused_span(pos, R)
+    # Coalesce adjacent general segments (fewer jit shapes to compile).
+    out: List[HorizonSegment] = []
+    for s in segs:
+        if (
+            out
+            and not s.fused
+            and not out[-1].fused
+            and out[-1].start + out[-1].rounds == s.start
+        ):
+            out[-1] = HorizonSegment(
+                out[-1].start, out[-1].rounds + s.rounds, False
+            )
+        else:
+            out.append(s)
+    return out
+
+
+def split_plan(
+    compiled: CompiledReconfig,
+    k: int = 8,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+    window: int = 4,
+) -> List[HorizonSegment]:
+    """The split-point planner: where the compiled schedule's horizon
+    splits into fused steady blocks vs general op rounds (ISSUE 11 — the
+    host-side evolution of `pending_in_horizon`, which remains the
+    per-block runtime guard).
+
+    Each scheduled op start round opens a `window`-round general window
+    (propose + dual-majority gate + apply complete in one round on a
+    steady fleet; the window absorbs short retry tails).  A JOINT-entering
+    op (its target config has outgoing voters) extends its window to the
+    selected groups' NEXT op start + window — the joint interval is
+    steady-rejected (not-joint condition) anyway, so planning it fused
+    would only buy rejected blocks — or to the horizon end when a
+    selected group's chain ends joint.  Fused spans additionally split at
+    every reconfig/chaos phase start (`plan_split_points` cuts): the
+    per-phase append workload and fault masks must be constant across a
+    fused block.
+    """
+    R = compiled.n_rounds
+    # graftcheck: allow-no-host-sync-in-jit — host-side planning over the
+    # small schedule arrays, before any jitted segment runs.
+    op_start = np.asarray(compiled.op_start)  # [K, G]
+    n_ops = np.asarray(compiled.n_ops)  # [G]
+    tgt_out = np.asarray(compiled.tgt_outgoing)  # [K, P, G]
+    phase_of_round = np.asarray(compiled.phase_of_round)
+    K = op_start.shape[0]
+    windows: List[Tuple[int, int]] = []
+    for ki in range(K):
+        valid = (ki < n_ops) & (op_start[ki] < NO_ROUND)
+        if not valid.any():
+            continue
+        for s in np.unique(op_start[ki][valid]):
+            sel = valid & (op_start[ki] == s)
+            end = int(s) + window
+            if tgt_out[ki][:, sel].any():
+                # Joint-entering op: general until the leave applies.
+                if ki + 1 < K:
+                    nxt = op_start[ki + 1][sel]
+                    has_next = (n_ops[sel] > ki + 1) & (nxt < NO_ROUND)
+                    if bool(has_next.all()):
+                        end = int(nxt.max()) + window
+                    else:
+                        end = R
+                else:
+                    end = R
+            windows.append((int(s), min(end, R)))
+    cuts = set((np.flatnonzero(np.diff(phase_of_round)) + 1).tolist())
+    if chaos_compiled is not None:
+        cph = np.asarray(chaos_compiled.phase_of_round)
+        cuts |= set((np.flatnonzero(np.diff(cph)) + 1).tolist())
+    return plan_split_points(R, windows, sorted(cuts), k)
+
+
+def _validate_plans(
     cfg: sim_mod.SimConfig,
     compiled: CompiledReconfig,
-    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
-):
-    """Build the jitted whole-scenario runner: ONE lax.scan over every
-    round of the compiled reconfig schedule — per-round op eligibility,
-    the conf-entry propose/gate/apply protocol, the joint-window safety
-    fold, and the MTTR/reconfig stats folds all fuse into the scan body
-    with zero host round trips.  `chaos_compiled` (optional, equal
-    n_rounds/n_peers) threads a compiled fault schedule through the SAME
-    scan: the link/crash/loss masks gather exactly as chaos.make_runner's
-    (chaos.schedule_masks is shared), so membership changes run *during*
-    partitions.
-
-    Like the chaos runner, every schedule array enters the jit as a
-    RUNTIME ARGUMENT (GC012: a closed-over schedule would bake into the
-    jaxpr as consts); only the shapes specialize the compile.  Returns a
-    callable (state, health, rstate) -> (state', health', rstate',
-    stats[N_CHAOS_STATS], rstats[N_RECONFIG_STATS], safety[N_SAFETY]);
-    state/health/rstate are donated.  ``runner.jitted`` /
-    ``runner.schedule_args`` are exposed for the graftcheck trace audit.
-    """
-    n_rounds = compiled.n_rounds
-    P, G = cfg.n_peers, cfg.n_groups
+    chaos_compiled: Optional[chaos_mod.CompiledChaos],
+) -> None:
+    """The shared runner-input compatibility checks (make_runner and
+    make_split_runner): equal horizons, agreeing peer counts."""
     if chaos_compiled is not None:
-        if chaos_compiled.n_rounds != n_rounds:
+        if chaos_compiled.n_rounds != compiled.n_rounds:
             raise ValueError(
                 f"chaos plan spans {chaos_compiled.n_rounds} rounds but "
-                f"the reconfig plan spans {n_rounds} — phases must cover "
-                "the same horizon to compose in one scan"
+                f"the reconfig plan spans {compiled.n_rounds} — phases "
+                "must cover the same horizon to compose in one scan"
             )
         if chaos_compiled.n_peers != compiled.n_peers:
             raise ValueError("chaos and reconfig plans disagree on peers")
-    if compiled.n_peers != P:
+    if compiled.n_peers != cfg.n_peers:
         raise ValueError(
-            f"plan has {compiled.n_peers} peers but cfg.n_peers == {P}"
+            f"plan has {compiled.n_peers} peers but cfg.n_peers == "
+            f"{cfg.n_peers}"
         )
 
-    def body(carry, r, sched, chaos_sched):
-        st, hl, rst, stats, rstats, safety = carry
+
+def _rebuild_scheds(compiled, chaos_compiled, sched_args):
+    """Rebind the runtime schedule arguments onto the compiled templates
+    (GC012: schedule arrays enter every runner jit as arguments, never as
+    closure consts) — shared by make_runner and make_split_runner."""
+    sched = compiled._replace(
+        phase_of_round=sched_args[0], append=sched_args[1],
+        op_start=sched_args[2], n_ops=sched_args[3],
+        tgt_voter=sched_args[4], tgt_outgoing=sched_args[5],
+        tgt_learner=sched_args[6], added=sched_args[7],
+        removed=sched_args[8],
+    )
+    if chaos_compiled is not None:
+        chaos_sched = chaos_compiled._replace(
+            phase_of_round=sched_args[9], link_packed=sched_args[10],
+            loss_packed=sched_args[11], crashed_packed=sched_args[12],
+            append=sched_args[13],
+        )
+    else:
+        chaos_sched = None
+    return sched, chaos_sched
+
+
+def _runner_body(
+    cfg: sim_mod.SimConfig,
+    sched: CompiledReconfig,
+    chaos_sched: Optional[chaos_mod.CompiledChaos],
+    with_counters: bool = False,
+):
+    """One general round of the compiled reconfig(+chaos) scenario as a
+    lax.scan body over the absolute round index — the SINGLE source of the
+    op propose/gate/apply protocol, shared by make_runner's whole-horizon
+    scan and make_split_runner's general segments / fused-block fallback.
+
+    Carry: (state, health, rstate, stats, rstats, safety) with an
+    [N_COUNTERS] int32 plane appended when `with_counters` (the split
+    runner's production configuration threads it; make_runner keeps the
+    historical carry and graph)."""
+    P, G = cfg.n_peers, cfg.n_groups
+
+    def body(carry, r):
+        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            st, hl, rst, stats, rstats, safety, ctrs = carry
+        else:
+            st, hl, rst, stats, rstats, safety = carry
+            ctrs = None
         ph = sched.phase_of_round[r]
         append = sched.append[ph]
         if chaos_sched is not None:
@@ -656,11 +844,17 @@ def make_runner(
         active = (rst.op_ptr < sched.n_ops) & (r >= start)
         want_prop = active & (rst.stage == 0)
         prev_leaderless = hl.planes[kernels.HP_LEADERLESS]
-        st2, hl2, prop = sim_mod.step(
+        step_out = sim_mod.step(
             cfg, st, crashed,
             append + want_prop.astype(jnp.int32),
-            health=hl, link=link, reconfig_propose=want_prop,
+            counters=ctrs, health=hl, link=link,
+            reconfig_propose=want_prop,
         )
+        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            st2, ctrs2, hl2, prop = step_out
+        else:
+            st2, hl2, prop = step_out
+            ctrs2 = None
         # Record where the conf entry landed (owner 0 = no alive leader
         # this round; the op stays at stage 0 and retries).
         got = want_prop & (prop.owner > 0)
@@ -741,24 +935,47 @@ def make_runner(
             prev_voter=st2.voter_mask,
             prev_outgoing=st2.outgoing_mask,
         )
-        return (st3, hl2, rst2, stats, rstats, safety), ()
+        out = (st3, hl2, rst2, stats, rstats, safety)
+        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            out = out + (ctrs2,)
+        return out, ()
+
+    return body
+
+
+def make_runner(
+    cfg: sim_mod.SimConfig,
+    compiled: CompiledReconfig,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+):
+    """Build the jitted whole-scenario runner: ONE lax.scan over every
+    round of the compiled reconfig schedule — per-round op eligibility,
+    the conf-entry propose/gate/apply protocol, the joint-window safety
+    fold, and the MTTR/reconfig stats folds all fuse into the scan body
+    with zero host round trips.  `chaos_compiled` (optional, equal
+    n_rounds/n_peers) threads a compiled fault schedule through the SAME
+    scan: the link/crash/loss masks gather exactly as chaos.make_runner's
+    (chaos.schedule_masks is shared), so membership changes run *during*
+    partitions.
+
+    Like the chaos runner, every schedule array enters the jit as a
+    RUNTIME ARGUMENT (GC012: a closed-over schedule would bake into the
+    jaxpr as consts); only the shapes specialize the compile.  Returns a
+    callable (state, health, rstate) -> (state', health', rstate',
+    stats[N_CHAOS_STATS], rstats[N_RECONFIG_STATS], safety[N_SAFETY]);
+    state/health/rstate are donated.  ``runner.jitted`` /
+    ``runner.schedule_args`` are exposed for the graftcheck trace audit.
+    """
+    n_rounds = compiled.n_rounds
+    _validate_plans(cfg, compiled, chaos_compiled)
+
+    def body(carry, r, sched, chaos_sched):
+        return _runner_body(cfg, sched, chaos_sched)(carry, r)
 
     def run(st, hl, rst, *sched_args):
-        sched = compiled._replace(
-            phase_of_round=sched_args[0], append=sched_args[1],
-            op_start=sched_args[2], n_ops=sched_args[3],
-            tgt_voter=sched_args[4], tgt_outgoing=sched_args[5],
-            tgt_learner=sched_args[6], added=sched_args[7],
-            removed=sched_args[8],
+        sched, chaos_sched = _rebuild_scheds(
+            compiled, chaos_compiled, sched_args
         )
-        if chaos_compiled is not None:
-            chaos_sched = chaos_compiled._replace(
-                phase_of_round=sched_args[9], link_packed=sched_args[10],
-                loss_packed=sched_args[11], crashed_packed=sched_args[12],
-                append=sched_args[13],
-            )
-        else:
-            chaos_sched = None
         stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
         rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
         safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
@@ -802,6 +1019,253 @@ def make_runner(
         return jitted(st, hl, rst, *schedule_args)
 
     runner.jitted = jitted  # type: ignore[attr-defined]
+    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
+    return runner
+
+
+def make_split_runner(
+    cfg: sim_mod.SimConfig,
+    compiled: CompiledReconfig,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+    k: int = 8,
+    window: int = 4,
+    with_counters: bool = False,
+    interpret: bool = False,
+):
+    """Build the SPLIT-HORIZON scenario runner (ISSUE 11): the same
+    protocol as make_runner — bit-identical end state, health planes,
+    op-protocol carry, and stats/safety accumulators — but the horizon is
+    split at reconfig op boundaries (`split_plan`) so the steady stretches
+    BETWEEN ops ride the fused Pallas kernel instead of the whole horizon
+    hard-rejecting because one op is scheduled somewhere.
+
+    Execution shape: planned general segments (op windows, joint
+    intervals, phase-cut remainders) run the per-round `_runner_body`
+    scan exactly like make_runner; planned fused segments run k-round
+    blocks, each a lax.cond between the fused steady kernel
+    (pallas_step.steady_round with health[, counters][, chaos loss]) and
+    the same k general rounds — guarded at runtime by
+    `steady_mask(reconfig_pending=pending_in_horizon(...),
+    loss_rate=...)` over the whole batch, so a retry tail that outlives
+    its planned window, an unsettled election, or a lossy chaos phase
+    falls back honestly.  A fused block provably cannot move the
+    op-protocol carry, the masks, the rstats, or the safety accumulator
+    (no op is eligible, the config is not joint, and every check_safety
+    slot is zero on a steady horizon — pinned by the split-vs-unsplit
+    parity suite), and its MTTR fold is the closed form of k leaderful
+    rounds; only `prev_voter`/`prev_outgoing` refresh so the next general
+    round's transition audit sees (unchanged -> current).
+
+    Dispatch is a short host loop over segments (a handful of jitted
+    calls with the carry donated end to end, schedule arrays as runtime
+    args per GC012) rather than make_runner's single scan: segment count
+    is O(ops), and async dispatch keeps the device busy across the
+    boundaries.
+
+    `with_counters` threads the [N_COUNTERS] int32 plane through both
+    branches (the production configuration); the caller drains it — the
+    GC008 bound is the caller's: n_rounds x G x events-per-group-round
+    must stay below 2**31 within one run (compile_plan already bounds
+    n_rounds x G).
+
+    Returns a callable runner(st, hl, rst[, counters]) ->
+    (st', hl', rst', stats, rstats, safety, fused_rounds[, counters']).
+    `fused_rounds` is an int32 scalar of fused GROUP-rounds (k x n_groups
+    per fused block that engaged); total group-rounds is
+    compiled.n_rounds x cfg.n_groups, so fused_frac = fused_rounds /
+    total — the measured number behind bench.py's `fused_frac` field.
+    st/hl/rst (and counters) are donated.  ``runner.segments``,
+    ``runner.fused_jit``, ``runner.general_jits`` and
+    ``runner.schedule_args`` are exposed for tests and the graftcheck
+    trace audit."""
+    from . import pallas_step  # deferred: keeps reconfig importable sans pallas
+
+    n_rounds = compiled.n_rounds
+    P, G = cfg.n_peers, cfg.n_groups
+    if not cfg.collect_health:
+        raise ValueError(
+            "make_split_runner needs SimConfig(collect_health=True) — the "
+            "MTTR stats and the fused block's closed-form fold ride on the "
+            "health planes"
+        )
+    if k > cfg.health_window:
+        raise ValueError(
+            f"fused block k={k} exceeds health_window={cfg.health_window}: "
+            "the closed-form health fold handles at most one churn-window "
+            "crossing per block"
+        )
+    _validate_plans(cfg, compiled, chaos_compiled)
+    chaos_on = chaos_compiled is not None
+    segments = split_plan(compiled, k, chaos_compiled, window)
+    assert segments and segments[0].start == 0 and sum(
+        s.rounds for s in segments
+    ) == n_rounds, "split_plan must tile the horizon exactly"
+    fused_fn = pallas_step.steady_round(
+        cfg, rounds=k, with_health=True, with_counters=with_counters,
+        with_chaos=chaos_on, interpret=interpret,
+    )
+    n_carry = 7 if with_counters else 6  # ... + fused accumulator below
+
+    def _unpack_rest(rest):
+        ctrs = rest[0] if with_counters else None
+        i = 1 if with_counters else 0
+        return ctrs, rest[i], rest[i + 1], rest[i + 2:]  # fused, r0, sched
+
+    def general_run(L):
+        def run_gen(st, hl, rst, stats, rstats, safety, *rest):
+            ctrs, fused, r0, sched_args = _unpack_rest(rest)
+            sched, chaos_sched = _rebuild_scheds(
+                compiled, chaos_compiled, sched_args
+            )
+            body = _runner_body(cfg, sched, chaos_sched, with_counters)
+            carry = (st, hl, rst, stats, rstats, safety)
+            if with_counters:
+                carry = carry + (ctrs,)
+            carry, _ = jax.lax.scan(
+                body, carry, r0 + jnp.arange(L, dtype=jnp.int32)
+            )
+            return carry + (fused,)
+
+        return run_gen
+
+    def fused_block_run(st, hl, rst, stats, rstats, safety, *rest):
+        ctrs, fused, r0, sched_args = _unpack_rest(rest)
+        sched, chaos_sched = _rebuild_scheds(
+            compiled, chaos_compiled, sched_args
+        )
+        body = _runner_body(cfg, sched, chaos_sched, with_counters)
+        if chaos_on:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            link, loss, crashed, capp = chaos_mod.schedule_planes(
+                chaos_sched, r0
+            )
+        else:
+            link = loss = None
+            crashed = jnp.zeros((P, G), bool)
+            capp = 0
+        append = sched.append[sched.phase_of_round[r0]] + capp
+        pend = pending_in_horizon(sched, rst, r0, k)
+        mask = pallas_step.steady_mask(
+            cfg, st, crashed, horizon=k, link=link,
+            reconfig_pending=pend, loss_rate=loss,
+        )
+        pred = jnp.all(mask)
+
+        def fast(args):
+            st, hl, rst, stats, rstats, safety, *c = args
+            prev_ll = hl.planes[kernels.HP_LEADERLESS]
+            fargs = (st, crashed, append)
+            if chaos_on:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                fargs = fargs + (loss, r0)
+            if with_counters:
+                fargs = fargs + (c[0],)
+            out = fused_fn(*fargs, hl)
+            if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                st2, ctrs2, hl2 = out
+            else:
+                st2, hl2 = out
+            # One closed-form MTTR fold for the whole block: the fused
+            # health fold pins HP_LEADERLESS to 0 every round (a leader
+            # held), so k per-round folds telescope to this single one.
+            stats2 = chaos_mod.update_chaos_stats(
+                stats, prev_ll, hl2.planes[kernels.HP_LEADERLESS]
+            )
+            # No op proposed/gated/applied and no mask moved (predicate):
+            # the op-protocol carry is unchanged except the transition-
+            # audit anchors, which refresh to (unchanged -> current)
+            # exactly like k general no-op rounds would leave them.
+            rst2 = rst._replace(
+                prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
+            )
+            res = (st2, hl2, rst2, stats2, rstats, safety)
+            if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+                res = res + (ctrs2,)
+            return res
+
+        def slow(args):
+            carry, _ = jax.lax.scan(
+                body, args, r0 + jnp.arange(k, dtype=jnp.int32)
+            )
+            return carry
+
+        args = (st, hl, rst, stats, rstats, safety)
+        if with_counters:
+            args = args + (ctrs,)
+        carry = jax.lax.cond(pred, fast, slow, args)
+        fused = fused + jnp.where(
+            pred, jnp.int32(k * G), jnp.int32(0)
+        )
+        return carry + (fused,)
+
+    donate = (0, 1, 2) + ((6,) if with_counters else ())
+    fused_jit = jax.jit(fused_block_run, donate_argnums=donate)
+    general_jits: Dict[int, Callable] = {}
+    for seg in segments:
+        if not seg.fused and seg.rounds not in general_jits:
+            general_jits[seg.rounds] = jax.jit(
+                general_run(seg.rounds), donate_argnums=donate
+            )
+    schedule_args = (
+        compiled.phase_of_round, compiled.append, compiled.op_start,
+        compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
+        compiled.tgt_learner, compiled.added, compiled.removed,
+    ) + (
+        (
+            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
+            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
+            chaos_compiled.append,
+        )
+        if chaos_on
+        else ()
+    )
+
+    def runner(st, hl, rst, counters=None):
+        if with_counters and counters is None:
+            raise ValueError(
+                "runner built with_counters=True needs the counters plane"
+            )
+        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
+        rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
+        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        carry = (st, hl, rst, stats, rstats, safety)
+        if with_counters:
+            carry = carry + (counters,)
+        carry = carry + (jnp.int32(0),)  # the fused group-round accumulator
+        for seg in segments:
+            if seg.fused:
+                for b in range(seg.rounds // k):
+                    carry = fused_jit(
+                        *carry,
+                        jnp.int32(seg.start + b * k),
+                        *schedule_args,
+                    )
+            else:
+                carry = general_jits[seg.rounds](
+                    *carry, jnp.int32(seg.start), *schedule_args
+                )
+        stf, hlf, rstf, stats, rstats, safety = carry[:6]
+        ctrs_f = carry[6] if with_counters else None
+        fused = carry[n_carry]
+        # Tail audit — the same one extra fold make_runner does: the scan
+        # body checks each apply's mask transition one round later, so a
+        # final-round apply needs this (prev_commit = final commit keeps
+        # the commit checks inert).
+        safety = safety + kernels.check_safety(
+            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+            stf.commit,
+            voter_mask=stf.voter_mask,
+            outgoing_mask=stf.outgoing_mask,
+            matched=stf.matched,
+            prev_voter_mask=rstf.prev_voter,
+            prev_outgoing_mask=rstf.prev_outgoing,
+        )
+        out = (stf, hlf, rstf, stats, rstats, safety, fused)
+        if with_counters:
+            out = out + (ctrs_f,)
+        return out
+
+    runner.segments = segments  # type: ignore[attr-defined]
+    runner.fused_jit = fused_jit  # type: ignore[attr-defined]
+    runner.general_jits = general_jits  # type: ignore[attr-defined]
     runner.schedule_args = schedule_args  # type: ignore[attr-defined]
     return runner
 

@@ -2732,41 +2732,79 @@ class ClusterSim:
 
     _DRAIN_MAX = 128  # never let a window exceed this many rounds
 
+    def _begin_drain(self) -> dict:
+        """Start a drain WITHOUT crossing to the host (ISSUE 11 drain/scan
+        overlap): capture the counter plane — swapping fresh zeros in, so
+        the next donated scan segment cannot consume the buffer being
+        drained — and dispatch the device-side health-summary reduction.
+        `_settle_drain` finishes the host side; run_compiled calls it only
+        AFTER the next segment is dispatched, so the device→host transfer
+        overlaps that segment's execution instead of serializing
+        consecutive scans."""
+        bufs: dict = {}
+        if self._counters is not None:
+            bufs["counters"] = self._counters
+            self._counters = kernels.zero_counters()
+        if self._health is not None and self.health_monitor is not None:
+            bufs["summary"] = self._summary_fn(self._health.planes)
+        self._rounds_since_drain = 0
+        return bufs
+
+    def _settle_drain(self, bufs: dict) -> None:
+        """Finish a drain started by _begin_drain: fold the captured
+        counter window into the unbounded host accumulator (running the
+        GC008 wrap check and the cadence adaptation) and push the health
+        summary to the attached monitor."""
+        from .health import HealthMonitor
+
+        counters = bufs.get("counters")
+        if counters is not None:
+            # graftcheck: allow-no-host-sync-in-jit — deliberate host-side
+            # drain: runs OUTSIDE the jitted step, at the adaptive cadence,
+            # and (in run_compiled) only after the NEXT segment was
+            # dispatched, so it overlaps device execution.
+            vals = jax.device_get(counters)
+            peak = 0
+            for i in range(kernels.N_COUNTERS):
+                v = int(vals[i])
+                if v < 0:
+                    raise RuntimeError(
+                        "device event counter wrapped int32 within one drain "
+                        "window; totals are corrupt — rerun with more frequent "
+                        "ClusterSim.counters() calls or fewer events per round"
+                    )
+                peak = max(peak, v)
+                self._host_counters[i] += v
+            # Adapt the cadence to the observed event rate: stay well clear
+            # of 2**31 per window, but don't sync more often than needed.
+            if peak > (1 << 29) and self._drain_every > 1:
+                self._drain_every //= 2
+            elif peak < (1 << 26) and self._drain_every < self._drain_cap:
+                self._drain_every *= 2
+        summary = bufs.get("summary")
+        if summary is not None:
+            # graftcheck: allow-no-host-sync-in-jit — the FIXED-SIZE summary
+            # download (never the [., G] planes), same overlap as above.
+            counts, hist, ids, scores = jax.device_get(summary)
+            self.health_monitor.record(
+                HealthMonitor.summary_dict(counts, hist, ids, scores)
+            )
+
     def _drain_counters(self) -> None:
-        # graftcheck: allow-no-host-sync-in-jit — deliberate host-side drain:
-        # runs OUTSIDE the jitted step, at the adaptive cadence documented
-        # above, precisely so the step itself never syncs.
-        vals = jax.device_get(self._counters)
-        peak = 0
-        for i in range(kernels.N_COUNTERS):
-            v = int(vals[i])
-            if v < 0:
-                raise RuntimeError(
-                    "device event counter wrapped int32 within one drain "
-                    "window; totals are corrupt — rerun with more frequent "
-                    "ClusterSim.counters() calls or fewer events per round"
-                )
-            peak = max(peak, v)
-            self._host_counters[i] += v
-        # Adapt the cadence to the observed event rate: stay well clear of
-        # 2**31 per window, but don't sync more often than needed.
-        if peak > (1 << 29) and self._drain_every > 1:
-            self._drain_every //= 2
-        elif peak < (1 << 26) and self._drain_every < self._drain_cap:
-            self._drain_every *= 2
+        """Blocking counter drain (run_round cadence / counters() reads)."""
+        bufs = {"counters": self._counters}
         self._counters = kernels.zero_counters()
         self._rounds_since_drain = 0
+        self._settle_drain(bufs)
 
     def _drain(self) -> None:
-        """Periodic host boundary: counter totals fold into the unbounded
-        host accumulator, and — when a monitor is attached — the fixed-size
-        health summary is pushed to it.  Both ride the same adaptive
-        cadence (the PR 1 drain), so health adds no extra sync points."""
-        if self._counters is not None:
-            self._drain_counters()
-        if self._health is not None and self.health_monitor is not None:
-            self.health_monitor.record(self._health_summary_dict())
-        self._rounds_since_drain = 0
+        """Periodic BLOCKING host boundary: counter totals fold into the
+        unbounded host accumulator, and — when a monitor is attached — the
+        fixed-size health summary is pushed to it.  Both ride the same
+        adaptive cadence (the PR 1 drain), so health adds no extra sync
+        points.  run_compiled uses the split _begin_drain/_settle_drain
+        pair instead, so its drains overlap the next scan segment."""
+        self._settle_drain(self._begin_drain())
 
     def run_round(self, crashed=None, append_n=None, link=None) -> SimState:
         """One protocol round; `link` (optional bool[P, P, G]) threads the
@@ -2887,7 +2925,16 @@ class ClusterSim:
         bit-packed 32:1 along G inside the scan (pack_ra_carry), unpacked
         at each step boundary — bit-identical to the run_round loop
         (tests/test_checkpoint.py) with ~32x less per-round carry traffic
-        for the plane."""
+        for the plane.
+
+        Drains never serialize consecutive segments (ISSUE 11): a due
+        drain only CAPTURES its buffers at the segment boundary
+        (_begin_drain — the counter plane swaps out of the donated carry
+        for fresh zeros, the health summary reduction is dispatched
+        device-side) and the host transfer + fold run after the NEXT
+        segment is dispatched, overlapping its execution.  Totals and the
+        monitor's summary stream are bit-identical to the blocking drain;
+        only the ordering moved."""
         G, P = self.cfg.n_groups, self.cfg.n_peers
         if crashed is None:
             crashed = jnp.zeros((P, G), bool)
@@ -2902,6 +2949,7 @@ class ClusterSim:
         else:
             seg_max = rounds
         done = 0
+        pending = None  # the previous segment's drain, not yet host-side
         while done < rounds:
             seg = min(seg_max, rounds - done)
             if cc and self._rounds_since_drain:
@@ -2909,6 +2957,9 @@ class ClusterSim:
                     # A residual run_round window plus this scan segment
                     # would stretch past the GC008-proven cap: settle it
                     # first (the drain zeroes the in-flight window).
+                    if pending is not None:
+                        self._settle_drain(pending)
+                        pending = None
                     self._drain()
             runner = self._compiled_runner(seg, link is not None)
             args = [self.state, crashed, append_n]
@@ -2919,6 +2970,16 @@ class ClusterSim:
             if link is not None:
                 args.append(link)
             out = runner(*args)
+            if pending is not None:
+                # Drain/scan overlap (ISSUE 11): the previous segment's
+                # drain crosses to the host only NOW — after this segment
+                # was dispatched — so the device→host transfer and the
+                # host fold overlap the running scan instead of
+                # serializing consecutive donated segments.  The drained
+                # buffers were swapped out of the carry by _begin_drain,
+                # so the donation above cannot consume them.
+                self._settle_drain(pending)
+                pending = None
             self.state = out[0]
             i = 1
             if cc:
@@ -2930,7 +2991,9 @@ class ClusterSim:
             if cc or ch:
                 self._rounds_since_drain += seg
                 if self._rounds_since_drain >= self._drain_every:
-                    self._drain()
+                    pending = self._begin_drain()
+        if pending is not None:
+            self._settle_drain(pending)
         return self.state
 
     # --- chaos engine (see raft_tpu/multiraft/chaos.py) ---
@@ -2993,7 +3056,8 @@ class ClusterSim:
     # --- reconfig engine (see raft_tpu/multiraft/reconfig.py) ---
 
     def run_reconfig(
-        self, plan, chaos_plan=None, stall_timeouts: int = 4
+        self, plan, chaos_plan=None, stall_timeouts: int = 4,
+        split: bool = False, split_k: int = 8, split_window: int = 4,
     ) -> dict:
         """Execute a membership-churn plan (reconfig.ReconfigPlan or
         CompiledReconfig) as ONE jitted lax.scan — the conf-entry
@@ -3013,6 +3077,17 @@ class ClusterSim:
         health.reconfig_stall event + gauge through an attached
         HealthMonitor) — no new device plane, just the existing
         commit-stall plane joined with the joint bit.
+
+        `split=True` (ISSUE 11) executes the plan through the
+        SPLIT-HORIZON runner (reconfig.make_split_runner): the steady
+        stretches between ops ride the fused Pallas kernel in
+        `split_k`-round blocks while the op windows (planned by
+        reconfig.split_plan with `split_window` rounds around each op)
+        run the general per-round body — bit-identical either way, with
+        the measured fused fraction added to the report as
+        `fused_frac`/`fused_rounds`/`total_rounds` (group-rounds).  With
+        collect_counters on, the counter plane threads through the split
+        run and drains into the host totals afterwards.
         """
         from . import chaos as chaos_mod
         from . import reconfig as reconfig_mod
@@ -3054,11 +3129,14 @@ class ClusterSim:
         # the old schedule.  A cache hit also reuses the lowered
         # CompiledReconfig, so repeated calls skip the Changer chain walk
         # and schedule re-upload entirely.
+        wc = split and self._counters is not None
+        mode = ("split", split_k, split_window, wc) if split else "scan"
         cached = getattr(self, "_reconfig_runner", None)
         if (
             cached is None
             or cached[0] is not plan
             or cached[1] is not chaos_plan
+            or cached[4] != mode
         ):
             if isinstance(plan, reconfig_mod.CompiledReconfig):
                 compiled = plan
@@ -3074,17 +3152,60 @@ class ClusterSim:
                 chaos_compiled = chaos_mod.compile_plan(
                     chaos_plan, self.cfg.n_groups
                 )
-            runner = reconfig_mod.make_runner(
-                self.cfg, compiled, chaos_compiled
+            if split:
+                runner = reconfig_mod.make_split_runner(
+                    self.cfg, compiled, chaos_compiled, k=split_k,
+                    window=split_window, with_counters=wc,
+                    interpret=jax.default_backend() == "cpu",
+                )
+            else:
+                runner = reconfig_mod.make_runner(
+                    self.cfg, compiled, chaos_compiled
+                )
+            self._reconfig_runner = (
+                plan, chaos_plan, compiled, runner, mode,
             )
-            self._reconfig_runner = (plan, chaos_plan, compiled, runner)
         else:
             compiled, runner = cached[2], cached[3]
         rst = reconfig_mod.init_reconfig_state(self.state)
-        (
-            self.state, self._health, self._reconfig_state,
-            stats, rstats, safety,
-        ) = runner(self.state, health, rst)
+        fused = None
+        if split:
+            if wc:
+                # The split run threads ONE counter window across the
+                # whole plan, so the GC008 wrap bound must hold for it:
+                # settle any residual run_round window first, and refuse
+                # plans longer than the proven per-window cap.
+                if self._rounds_since_drain:
+                    self._drain_counters()
+                if compiled.n_rounds > self._drain_cap:
+                    raise ValueError(
+                        f"plan spans {compiled.n_rounds} rounds but the "
+                        f"GC008 drain cap at this batch size is "
+                        f"{self._drain_cap} rounds per undrained window; "
+                        "run the plan through reconfig.make_split_runner "
+                        "directly (managing the counter plane yourself) "
+                        "or split the plan"
+                    )
+            out = runner(
+                self.state, health, rst,
+                *((self._counters,) if wc else ()),
+            )
+            (
+                self.state, self._health, self._reconfig_state,
+                stats, rstats, safety, fused,
+            ) = out[:7]
+            if wc:
+                # Fold the run's window into the host totals (wrap check
+                # included) — the plane must not sit loaded under a zeroed
+                # _rounds_since_drain, or the next run_round window would
+                # stack on top of it past the proven cap.
+                self._counters = out[7]
+                self._drain_counters()
+        else:
+            (
+                self.state, self._health, self._reconfig_state,
+                stats, rstats, safety,
+            ) = runner(self.state, health, rst)
         # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
         # download of fixed-size stat vectors + two small planes,
         # outside the jitted scan.
@@ -3101,6 +3222,15 @@ class ClusterSim:
             stats_h, rstats_h, safety_h, compiled.n_rounds,
             n_stuck, worst,
         )
+        if fused is not None:
+            total = compiled.n_rounds * self.cfg.n_groups
+            # graftcheck: allow-no-host-sync-in-jit — one int32 scalar,
+            # downloaded with the report, outside the jitted segments.
+            report["fused_rounds"] = int(jax.device_get(fused))
+            report["total_rounds"] = total
+            report["fused_frac"] = round(
+                report["fused_rounds"] / total, 4
+            )
         if self.health_monitor is not None:
             self.health_monitor.record_reconfig(report)
         return report

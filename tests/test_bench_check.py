@@ -125,3 +125,62 @@ def test_run_check_update_baseline(tmp_path):
         update_baseline=False,
     )
     bench.run_check(args2, line(median=900e6))
+
+
+def test_check_retired_baseline_skips_with_notice():
+    """A `"retired": true` entry (e.g. the pre-fusion wave-replay `_cq`
+    anchor) is a historical number, not a live gate: --check must skip it
+    with a notice even when the run's median is far below it."""
+    baseline = {
+        key(): {
+            "median": 1e15, "threshold_pct": 15.0, "retired": True,
+            "note": "historical anchor",
+        }
+    }
+    ok, verdict = bench.check_against_baseline(line(median=900e6), baseline)
+    assert ok and verdict["status"] == "retired-baseline"
+    assert verdict["note"] == "historical anchor"
+
+
+def test_run_check_update_baseline_refuses_retired(tmp_path):
+    """--update-baseline must not silently overwrite a retired anchor:
+    reviving a retired series is a deliberate hand edit."""
+    basefile = tmp_path / "base.json"
+    basefile.write_text(
+        json.dumps({key(): {"median": 1.0, "retired": True}}),
+        encoding="utf-8",
+    )
+    args = argparse.Namespace(
+        check=str(basefile), check_out="", check_threshold=None,
+        update_baseline=True,
+    )
+    with pytest.raises(SystemExit) as e:
+        bench.run_check(args, line(median=900e6))
+    assert e.value.code == 1
+    saved = json.loads(basefile.read_text(encoding="utf-8"))
+    assert saved[key()]["median"] == 1.0  # untouched
+
+
+def test_committed_cq_anchor_is_retired():
+    """The committed BENCH_baseline.json must carry the retired flag on
+    the wave-replay `_cq` series (the ISSUE 11 stale-anchor fix)."""
+    base = json.loads(
+        (Path(__file__).resolve().parent.parent / "BENCH_baseline.json")
+        .read_text(encoding="utf-8")
+    )
+    entry = base["raft_ticks_per_sec_100k_groups_5_peers_cq@cpu@g256"]
+    assert entry.get("retired") is True
+
+
+def test_fused_fields_units_and_counter():
+    """fused_fields: group-round units, 4-digit ratio, and the
+    multiraft_fused_rounds_total counter fold."""
+    bench.fused_fields(0, 0)  # ensure the family + implicit child exist
+    child = bench.METRICS.counter("multiraft_fused_rounds_total")._children[()]
+    before = child.value
+    got = bench.fused_fields(300, 400)
+    assert got == {
+        "fused_rounds": 300, "total_rounds": 400, "fused_frac": 0.75,
+    }
+    assert bench.fused_fields(0, 0)["fused_frac"] == 0.0
+    assert child.value == before + 300

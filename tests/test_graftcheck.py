@@ -783,6 +783,16 @@ def test_gc008_drain_cadence_beyond_wrap_bound_flags(tmp_path):
     assert len(gc8) == 1 and "wraps at 2**31" in gc8[0].message
 
 
+def test_gc008_backstop_in_settle_drain_passes(tmp_path):
+    # ISSUE 11 moved the wrap backstop into the split drain's host half
+    # (_settle_drain); the rule accepts either home.
+    src = _GC008_SIM.format(cap="1 << 31").replace(
+        "_drain_counters", "_settle_drain"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/sim.py": src})
+    assert [v.rule_id for v in vs if v.rule_id == "GC008"] == []
+
+
 def test_gc008_missing_wrap_backstop_flags(tmp_path):
     # The backstop check must look for the v<0 raise INSIDE
     # _drain_counters: an unrelated raise elsewhere in the class (the

@@ -952,3 +952,168 @@ def test_fast_multi_round_health_both_branches():
                 np.asarray(getattr(got_st, f)),
                 err_msg=f"{start} field {f}",
             )
+
+
+# --- ISSUE 11: per-group lossy cq bound, fused counting, hybrid chaos -------
+
+
+def test_cq_boundary_safe_per_group_lossy_bound():
+    """kernels.cq_boundary_safe(lossy=): the boundary condition is PER
+    GROUP — a lossy group with an in-horizon boundary rejects while a
+    loss-free group with the same timer phase keeps the saturation proof,
+    and lossy=None reproduces the historical all-lossless behavior."""
+    from raft_tpu.multiraft import kernels
+
+    P, G = 3, 4
+    state = jnp.zeros((P, G), jnp.int32).at[0].set(kernels.ROLE_LEADER)
+    voter = jnp.ones((P, G), bool)
+    outgoing = jnp.zeros((P, G), bool)
+    crashed = jnp.zeros((P, G), bool)
+    # Leader row fully active (acks from everyone) in every group.
+    ra = jnp.zeros((P, P, G), bool).at[0].set(True)
+    # Leaders of groups 1 and 3 hit their boundary inside horizon=4.
+    ee = jnp.zeros((P, G), jnp.int32).at[0, 1].set(8).at[0, 3].set(8)
+    args = (ra, voter, outgoing, state, crashed, ee, 4, 10)
+    np.testing.assert_array_equal(
+        np.asarray(kernels.cq_boundary_safe(*args)),
+        [True, True, True, True],  # lossless proof covers boundaries
+    )
+    lossy = jnp.asarray([False, True, True, False])
+    np.testing.assert_array_equal(
+        np.asarray(kernels.cq_boundary_safe(*args, lossy=lossy)),
+        # group 1: lossy + boundary in horizon -> rejected; group 2:
+        # lossy but no boundary -> free-running bound passes; group 3:
+        # boundary in horizon but loss-free -> saturation proof holds.
+        [True, False, True, True],
+    )
+    # A crashed stale leader reaching its boundary rejects either way.
+    crashed2 = crashed.at[0, 0].set(True)
+    got = kernels.cq_boundary_safe(
+        ra, voter, outgoing, state, crashed2,
+        ee.at[0, 0].set(9), 4, 10,
+    )
+    assert not bool(got[0])
+
+
+def test_steady_mask_loss_rate_per_group(cq_settled):
+    """steady_mask(loss_rate=): only groups with a nonzero rate keep the
+    conservative no-boundary bound; zero-rate groups fuse through their
+    check-quorum boundary exactly like the lossless branch."""
+    from raft_tpu.multiraft import kernels
+
+    s, snap = cq_settled
+    cfg = s.cfg
+    st = _restore(snap)
+    G, P = cfg.n_groups, cfg.n_peers
+    crashed = jnp.zeros((P, G), bool)
+    link = jnp.ones((P, P, G), bool)
+    k = 4
+    # Force every leader's boundary inside the horizon.
+    lead = st.state == 2
+    st = st._replace(
+        election_elapsed=jnp.where(
+            lead, jnp.int32(cfg.election_tick - 2), st.election_elapsed
+        )
+    )
+    lossless = pallas_step.steady_mask(cfg, st, crashed, k)
+    rate = jnp.where(jnp.arange(G) % 2 == 0, 25, 0)
+    rate = jnp.broadcast_to(rate[None, None, :], (P, P, G)).astype(jnp.int32)
+    got = np.asarray(
+        pallas_step.steady_mask(
+            cfg, st, crashed, k, link=link, loss_rate=rate
+        )
+    )
+    # Lossy groups (even): boundary in horizon -> rejected.  Loss-free
+    # groups (odd): same steadiness the lossless branch proves.
+    assert not got[::2].any()
+    np.testing.assert_array_equal(got[1::2], np.asarray(lossless)[1::2])
+    # Without loss_rate the historical all-groups conservative form
+    # rejects everything (boundary everywhere).
+    old = np.asarray(
+        pallas_step.steady_mask(cfg, st, crashed, k, link=link)
+    )
+    assert not old.any()
+
+
+def test_fast_multi_round_count_fused_plain():
+    """count_fused: the trailing int32 accumulator counts k * n_groups
+    group-rounds per fused block, 0 per fallback block, and the counted
+    dispatch stays bit-identical to k general steps."""
+    cfg = SimConfig(n_groups=8, n_peers=3)
+    k = 2
+    fast = pallas_step.fast_multi_round(cfg, k=k, count_fused=True)
+    crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
+    append = jnp.ones((cfg.n_groups,), jnp.int32)
+    for start, want_count in (("steady", k * cfg.n_groups), ("boot", 0)):
+        st = settle(cfg) if start == "steady" else sim.init_state(cfg)
+        want = st
+        for _ in range(k):
+            want = sim.step(cfg, want, crashed, append)
+        got, fused = fast(st, crashed, append, jnp.int32(5))
+        assert int(fused) - 5 == want_count, start
+        for f in st._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(want, f)),
+                np.asarray(getattr(got, f)),
+                err_msg=f"{start} field {f}",
+            )
+
+
+@pytest.mark.slow  # damped chaos fused kernel + two general damped scans
+def test_hybrid_damped_chaos_per_group_split():
+    """hybrid_multi_round(with_chaos=True) on the damped configuration:
+    spread check-quorum boundary phases + per-group loss rates split the
+    batch PER GROUP — steady groups ride the fused damped chaos kernel,
+    boundary-crossing/lossy-bound groups take the general wave path with
+    their global group ids keying both seeded PRNG streams — and the
+    merge is bit-identical to k sequential sim.step(link & ~loss_draw)
+    rounds.  The count_fused accumulator reports exactly k x (steady
+    group count)."""
+    from raft_tpu.multiraft import kernels
+
+    G, P, k = 12, 3, 4
+    cfg = SimConfig(
+        n_groups=G, n_peers=P, election_tick=16, check_quorum=True,
+        pre_vote=True,
+    )
+    st = settle(cfg, rounds=3 * cfg.election_tick)
+    crashed = jnp.zeros((P, G), bool)
+    append = jnp.ones((G,), jnp.int32)
+    link = jnp.ones((P, P, G), bool)
+    loss = jnp.where(jnp.arange(G) % 2 == 0, kernels.LOSS_SCALE // 50, 0)
+    loss = jnp.broadcast_to(loss[None, None, :], (P, P, G)).astype(jnp.int32)
+    rb = jnp.int32(100)
+    # Spread the leaders' boundary phases deterministically so SOME lossy
+    # groups have an in-horizon boundary and some don't.
+    lead = np.array(st.state == kernels.ROLE_LEADER)
+    ee = np.array(st.election_elapsed)
+    phases = (np.arange(G) * 5) % cfg.election_tick
+    for g in range(G):
+        for p in range(P):
+            if lead[p, g]:
+                ee[p, g] = phases[g]
+    st = st._replace(election_elapsed=jnp.asarray(ee))
+    mask = pallas_step.steady_mask(
+        cfg, st, crashed, horizon=k, link=link, loss_rate=loss
+    )
+    n_steady = int(mask.sum())
+    assert 0 < n_steady < G, "fixture must mix fused and storm groups"
+
+    ref = st
+    for r in range(k):
+        lk = link & ~kernels.link_loss_draw(rb + r, loss)
+        ref = sim.step(cfg, ref, crashed, append, link=lk)
+
+    fn = pallas_step.hybrid_multi_round(
+        cfg, k=k, storm_slots=8, with_chaos=True, count_fused=True
+    )
+    out, fused = jax.jit(fn)(
+        st, crashed, append, link, loss, rb, jnp.int32(0)
+    )
+    assert int(fused) == k * n_steady
+    for f in st._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)),
+            np.asarray(getattr(out, f)),
+            err_msg=f"field {f}",
+        )

@@ -1,0 +1,360 @@
+"""Split-horizon reconfig execution (ISSUE 11).
+
+Three claims are pinned here:
+
+  1. the split-point planner (`reconfig.plan_split_points` /
+     `reconfig.split_plan`) tiles the horizon exactly, opens general
+     windows at op starts (merging back-to-back ops, extending
+     joint-entering ops to their leave), cuts fused spans at schedule
+     phase starts, degrades remainders to general rounds, and yields ONE
+     full fused segment for an op-free horizon;
+  2. `reconfig.make_split_runner` is bit-identical to the unsplit
+     `make_runner` scan — state, health planes, op-protocol carry, and
+     every stats/safety accumulator — while actually engaging the fused
+     kernel (fused_rounds > 0) on the steady stretches between ops;
+  3. the ClusterSim.run_reconfig(split=True) wiring reports the measured
+     fused fraction.
+
+Tier-1 keeps the planner battery (pure host, no compiles) and ONE
+undamped G=8 split-vs-unsplit parity case; the G=32 production
+composition (health + counters + chaos + cq + pv) and the ClusterSim
+wiring case are @pytest.mark.slow per the saturated 870s gate — paid for
+by slow-marking the 3-seed plain read-index storm (see
+tools/tier1_budget.py top-N; its mixed/joint/learners/even-P siblings
+keep the storm shape in tier-1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.multiraft import ClusterSim, SimConfig
+from raft_tpu.multiraft import chaos, kernels, reconfig
+from raft_tpu.multiraft import sim as sim_mod
+
+
+@pytest.fixture(autouse=True)
+def _interpret_pallas(monkeypatch):
+    # CPU test environment: run pallas in interpreter mode.
+    from jax.experimental import pallas as pl
+
+    orig = pl.pallas_call
+
+    def patched(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(pl, "pallas_call", patched)
+    yield
+
+
+def seg(start, rounds, fused):
+    return reconfig.HorizonSegment(start, rounds, fused)
+
+
+# --- claim 1: the split-point planner ---------------------------------------
+
+
+def test_planner_empty_plan_one_full_fused_segment():
+    assert reconfig.plan_split_points(64, [], (), k=8) == [seg(0, 64, True)]
+    # A non-multiple horizon degrades only its remainder to general.
+    assert reconfig.plan_split_points(60, [], (), k=8) == [
+        seg(0, 56, True), seg(56, 4, False),
+    ]
+
+
+def test_planner_op_at_round_zero():
+    assert reconfig.plan_split_points(64, [(0, 4)], (), k=4) == [
+        seg(0, 4, False), seg(4, 60, True),
+    ]
+
+
+def test_planner_back_to_back_ops_merge():
+    # Adjacent/overlapping op windows coalesce into one general segment.
+    assert reconfig.plan_split_points(32, [(8, 12), (12, 16)], (), k=4) == [
+        seg(0, 8, True), seg(8, 8, False), seg(16, 16, True),
+    ]
+    assert reconfig.plan_split_points(32, [(8, 14), (10, 16)], (), k=4) == [
+        seg(0, 8, True), seg(8, 8, False), seg(16, 16, True),
+    ]
+
+
+def test_planner_op_in_final_round():
+    # The window clips at the horizon end; the sub-k fused tail and the
+    # window coalesce into one trailing general segment.
+    assert reconfig.plan_split_points(32, [(31, 35)], (), k=4) == [
+        seg(0, 28, True), seg(28, 4, False),
+    ]
+
+
+def test_planner_cuts_subdivide_fused_spans():
+    # A schedule-phase start inside a fused span splits it; sub-k pieces
+    # degrade to general rounds.
+    assert reconfig.plan_split_points(32, [], (10,), k=4) == [
+        seg(0, 8, True), seg(8, 2, False), seg(10, 20, True),
+        seg(30, 2, False),
+    ]
+
+
+def test_planner_tiles_exactly():
+    rng = np.random.RandomState(7)
+    for _ in range(50):
+        R = int(rng.randint(1, 200))
+        wins = [
+            (int(a), int(a + rng.randint(1, 9)))
+            for a in rng.randint(0, max(1, R), size=rng.randint(0, 4))
+        ]
+        cuts = [int(c) for c in rng.randint(1, max(2, R), size=3)]
+        k = int(rng.choice([2, 4, 8]))
+        segs = reconfig.plan_split_points(R, wins, cuts, k=k)
+        assert segs[0].start == 0
+        assert sum(s.rounds for s in segs) == R
+        for a, b in zip(segs, segs[1:]):
+            assert a.start + a.rounds == b.start
+        for s in segs:
+            if s.fused:
+                assert s.rounds % k == 0 and s.rounds > 0
+
+
+def _joint_plan(extra_settle=16):
+    return reconfig.ReconfigPlan(
+        name="split-joint", n_peers=3, voters=[1, 2], learners=[3],
+        phases=[
+            reconfig.ReconfigPhase(rounds=16, append=1),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"enter_joint": [{"add": 3}]}
+            ),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"leave_joint": True}
+            ),
+            reconfig.ReconfigPhase(rounds=extra_settle, append=1),
+        ],
+    )
+
+
+def test_split_plan_joint_window_extends_to_leave():
+    compiled = reconfig.compile_plan(_joint_plan(), 4)
+    segs = reconfig.split_plan(compiled, k=4, window=4)
+    # enter_joint at 16 must stay general until the leave (24) + window,
+    # in ONE general segment — planning the joint interval fused would
+    # only buy steady-rejected blocks.
+    assert seg(16, 12, False) in segs
+    assert sum(s.rounds for s in segs) == compiled.n_rounds
+    # ...and a joint-entering op with NO leave extends to the horizon end.
+    tail = reconfig.ReconfigPlan(
+        name="split-joint-tail", n_peers=3, voters=[1, 2],
+        phases=[
+            reconfig.ReconfigPhase(rounds=16, append=1),
+            reconfig.ReconfigPhase(
+                rounds=16, append=1, op={"enter_joint": [{"add": 3}]}
+            ),
+        ],
+    )
+    segs = reconfig.split_plan(reconfig.compile_plan(tail, 4), k=4)
+    assert segs[-1] == seg(16, 16, False)
+
+
+def test_split_plan_simple_op_window_only():
+    plan = reconfig.ReconfigPlan(
+        name="split-simple", n_peers=3, voters=[1, 2], learners=[3],
+        phases=[
+            reconfig.ReconfigPhase(rounds=16, append=1),
+            reconfig.ReconfigPhase(
+                rounds=16, append=1, op={"promote_learner": 3}
+            ),
+        ],
+    )
+    segs = reconfig.split_plan(reconfig.compile_plan(plan, 4), k=4, window=4)
+    assert segs == [
+        seg(0, 16, True), seg(16, 4, False), seg(20, 12, True),
+    ]
+
+
+# --- claim 2: split-vs-unsplit parity ---------------------------------------
+
+
+FIELDS = tuple(sim_mod.SimState._fields)
+
+
+def _assert_run_equal(out1, out2, note):
+    st1, hl1, rst1, stats1, rstats1, safety1 = out1[:6]
+    st2, hl2, rst2, stats2, rstats2, safety2 = out2[:6]
+    for f in FIELDS:
+        a, b = getattr(st1, f), getattr(st2, f)
+        if a is None and b is None:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"{note}: state {f}"
+        )
+    np.testing.assert_array_equal(
+        np.asarray(hl1.planes), np.asarray(hl2.planes),
+        err_msg=f"{note}: health planes",
+    )
+    assert int(hl1.window_pos) == int(hl2.window_pos), note
+    for f in reconfig.ReconfigState._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst1, f)), np.asarray(getattr(rst2, f)),
+            err_msg=f"{note}: rstate {f}",
+        )
+    for name, a, b in (
+        ("chaos stats", stats1, stats2),
+        ("rstats", rstats1, rstats2),
+        ("safety", safety1, safety2),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"{note}: {name}"
+        )
+
+
+def test_split_runner_matches_unsplit_g8():
+    """The tier-1 split-vs-unsplit parity case: an undamped G=8 plan with
+    a mid-horizon promote op — elections settle inside the horizon (the
+    early blocks honestly reject), then the fused blocks engage; every
+    output of the split runner must equal the unsplit scan's, and the
+    fused accumulator must show real (partial) fused coverage."""
+    G = 8
+    plan = reconfig.ReconfigPlan(
+        name="tier1-split", n_peers=3, voters=[1, 2], learners=[3],
+        phases=[
+            reconfig.ReconfigPhase(rounds=24, append=1),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"promote_learner": 3}
+            ),
+            reconfig.ReconfigPhase(rounds=32, append=1),
+        ],
+    )
+    cfg = SimConfig(n_groups=G, n_peers=3, collect_health=True)
+    compiled = reconfig.compile_plan(plan, G)
+
+    def fresh():
+        st = sim_mod.init_state(cfg, *reconfig.initial_masks(plan, G))
+        return st, sim_mod.init_health(cfg), reconfig.init_reconfig_state(st)
+
+    out1 = reconfig.make_runner(cfg, compiled)(*fresh())
+    runner = reconfig.make_split_runner(
+        cfg, compiled, k=4, window=4, interpret=True
+    )
+    out2 = runner(*fresh())
+    _assert_run_equal(out1, out2, "g8-split")
+    fused = int(out2[6])
+    total = plan.n_rounds * G
+    # Real fused engagement, real honest fallback: the boot storm and the
+    # op window cannot fuse, the settled stretches must.
+    assert 0 < fused < total, (fused, total)
+    assert not np.asarray(out2[5]).any(), "safety violations"
+    # The op applied everywhere despite the split.
+    assert (np.asarray(out2[2].op_ptr) == 1).all()
+
+
+@pytest.mark.slow
+def test_split_runner_prod_composition_g32():
+    """The production composition at G=32: health + counters + chaos
+    overlay + check-quorum + pre-vote + a 3-op plan through the split
+    runner — bit-identical to the unsplit scan (which cannot thread
+    counters; those are cross-checked against the stepped with_counters
+    body), with real fused coverage."""
+    G = 32
+    plan = reconfig.ReconfigPlan(
+        name="slow-split-prod", n_peers=3, voters=[1, 2], learners=[3],
+        phases=[
+            # Damped elections at G=32 need ~70 rounds to fully settle
+            # (the last straggler group gates the whole-batch predicate).
+            reconfig.ReconfigPhase(rounds=80, append=1),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"promote_learner": 3}
+            ),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"enter_joint": [{"remove": 2}]}
+            ),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"leave_joint": True}
+            ),
+            reconfig.ReconfigPhase(rounds=24, append=1),
+        ],
+    )
+    cplan = chaos.ChaosPlan(
+        name="slow-split-chaos", n_peers=3,
+        phases=[
+            chaos.ChaosPhase(rounds=104),
+            chaos.ChaosPhase(rounds=16, loss_all=0.03),
+            chaos.ChaosPhase(rounds=8),
+        ],
+    )
+    cfg = SimConfig(
+        n_groups=G, n_peers=3, collect_health=True, collect_counters=True,
+        check_quorum=True, pre_vote=True, election_tick=16,
+    )
+    compiled = reconfig.compile_plan(plan, G)
+    ccompiled = chaos.compile_plan(cplan, G)
+
+    def fresh():
+        st = sim_mod.init_state(cfg, *reconfig.initial_masks(plan, G))
+        return st, sim_mod.init_health(cfg), reconfig.init_reconfig_state(st)
+
+    out1 = reconfig.make_runner(cfg, compiled, ccompiled)(*fresh())
+    runner = reconfig.make_split_runner(
+        cfg, compiled, ccompiled, k=4, window=4, with_counters=True,
+        interpret=True,
+    )
+    st0, hl0, rst0 = fresh()
+    out2 = runner(st0, hl0, rst0, kernels.zero_counters())
+    _assert_run_equal(out1, out2, "g32-prod")
+    fused, ctrs = int(out2[6]), out2[7]
+    assert 0 < fused < plan.n_rounds * G
+    # Counters: exact vs the per-round with_counters body, stepped.
+    body = reconfig._runner_body(cfg, compiled, ccompiled, with_counters=True)
+    st0, hl0, rst0 = fresh()
+    carry = (
+        st0, hl0, rst0,
+        jnp.zeros((chaos.N_CHAOS_STATS,), jnp.int32),
+        jnp.zeros((reconfig.N_RECONFIG_STATS,), jnp.int32),
+        jnp.zeros((kernels.N_SAFETY,), jnp.int32),
+        kernels.zero_counters(),
+    )
+    stepped = jax.jit(lambda c, r: body(c, r)[0])
+    for r in range(plan.n_rounds):
+        carry = stepped(carry, jnp.int32(r))
+    np.testing.assert_array_equal(
+        np.asarray(carry[6]), np.asarray(ctrs), err_msg="counters"
+    )
+
+
+@pytest.mark.slow
+def test_cluster_sim_run_reconfig_split_report():
+    """ClusterSim.run_reconfig(split=True) wiring: same report shape as
+    the unsplit path plus the measured fused fields, zero safety, all ops
+    applied — and the counter plane threaded through the split run is
+    DRAINED into the host totals afterwards (the window must not sit
+    loaded under a zeroed _rounds_since_drain, or the next run_round
+    window would stack past the GC008 cap)."""
+    G = 8
+    plan = reconfig.ReconfigPlan(
+        name="cs-split", n_peers=3, voters=[1, 2], learners=[3],
+        phases=[
+            reconfig.ReconfigPhase(rounds=24, append=1),
+            reconfig.ReconfigPhase(
+                rounds=8, append=1, op={"promote_learner": 3}
+            ),
+            reconfig.ReconfigPhase(rounds=16, append=1),
+        ],
+    )
+    cfg = SimConfig(
+        n_groups=G, n_peers=3, collect_health=True, collect_counters=True
+    )
+    cs = ClusterSim(cfg, *reconfig.initial_masks(plan, G))
+    report = cs.run_reconfig(plan, split=True, split_k=4)
+    assert report["total_rounds"] == plan.n_rounds * G
+    assert 0 < report["fused_rounds"] < report["total_rounds"]
+    assert report["fused_frac"] == round(
+        report["fused_rounds"] / report["total_rounds"], 4
+    )
+    assert not any(report["safety"].values())
+    assert report["ops_applied"] == G
+    # The split run's counter window landed in the host totals, the
+    # device plane is settled, and the drain bookkeeping is clean.
+    assert sum(cs._host_counters) > 0
+    assert int(np.asarray(cs._counters).sum()) == 0
+    assert cs._rounds_since_drain == 0
+    totals = cs.counters()
+    assert totals["heartbeats"] > 0 and totals["commit_entries"] > 0

@@ -285,6 +285,61 @@ def _reconfig_runner_builder(with_chaos: bool, damping: dict):
     return build
 
 
+def _split_runner_builder():
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.multiraft import chaos, kernels, reconfig
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True,
+            collect_counters=True, check_quorum=True, pre_vote=True,
+        )
+        plan = reconfig.ReconfigPlan(
+            name="graftcheck-inventory",
+            n_peers=P,
+            phases=[
+                reconfig.ReconfigPhase(rounds=8, append=1),
+                reconfig.ReconfigPhase(
+                    rounds=8, op={"add_voter": 3}, append=1
+                ),
+            ],
+            voters=[1, 2],
+        )
+        cplan = chaos.ChaosPlan(
+            name="graftcheck-inventory",
+            n_peers=P,
+            phases=[chaos.ChaosPhase(rounds=16, loss_all=0.01)],
+        )
+        compiled = reconfig.compile_plan(plan, G)
+        chaos_compiled = chaos.compile_plan(cplan, G)
+        vm, om, lm = reconfig.initial_masks(plan, G)
+        st = sim.init_state(cfg, vm, om, lm)
+        runner = reconfig.make_split_runner(
+            cfg, compiled, chaos_compiled, k=DISPATCH_K, window=4,
+            with_counters=True,
+            interpret=jax.default_backend() != "tpu",
+        )
+        # The fused-block jit is the split runner's hot graph: the
+        # steady-predicate + pending guard, the fused kernel, AND the
+        # k-round general fallback all under one cond; the carry
+        # (state, health, rstate, counters) is donated end to end.
+        args = (
+            st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
+            jnp.zeros((chaos.N_CHAOS_STATS,), jnp.int32),
+            jnp.zeros((reconfig.N_RECONFIG_STATS,), jnp.int32),
+            jnp.zeros((kernels.N_SAFETY,), jnp.int32),
+            kernels.zero_counters(),
+            jnp.int32(0),
+            jnp.int32(0),
+        ) + runner.schedule_args
+        return Built(runner.fused_jit, args, (0, 1, 2, 6))
+
+    return build
+
+
 def _sharded_builder(kind: str):
     def build() -> Built:
         import jax
@@ -430,6 +485,17 @@ def _specs() -> List[GraphSpec]:
             build=_reconfig_runner_builder(
                 True, {"check_quorum": True, "pre_vote": True}
             ),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The ISSUE 11 split-horizon fused block: the production
+            # configuration's hot graph (health + counters + chaos +
+            # cq+pv), carrying the fused kernel and the k-round general
+            # fallback under one cond with the whole carry donated.
+            name=f"reconfig_split{DISPATCH_K}@chaos+cq+pv",
+            anchor=reconfig_py,
+            build=_split_runner_builder(),
         )
     )
     sharding_py = "raft_tpu/multiraft/sharding.py"
