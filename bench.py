@@ -704,6 +704,115 @@ def bench_prod_fused(
     }
 
 
+def bench_autopilot(
+    groups: int,
+    reps: int,
+    chaos_path: str = "",
+    cadence: int = 16,
+    out: str = "",
+) -> dict:
+    """The closed-loop configuration (ISSUE 12): the Zipf hot-region
+    workload (benches/suites.py config 3's TiKV-style skew), a
+    crash-window chaos overlay, and the autopilot's kick/transfer healing
+    in one run — the healthy stretches ride the fused Pallas cadence
+    segments (autopilot.make_cadence_runner's fused branch), the chaos
+    window and every acted-on segment take the general path, and the
+    per-cadence host policy round trips are INSIDE the timed region (the
+    closed loop's cost is the number being reported).
+
+    Leaders settle outside the timed region (3x election_tick rounds);
+    each rep replays from a copy of the settled state with a fresh
+    Autopilot (deterministic policy: identical actions every rep)."""
+    from raft_tpu.multiraft import ClusterSim, chaos
+    from raft_tpu.multiraft.autopilot import Autopilot, AutopilotConfig
+    from raft_tpu.multiraft.sim import SimConfig
+
+    PEERS = 5
+    if chaos_path:
+        with open(chaos_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    else:
+        doc = {
+            "name": "autopilot-bench",
+            "peers": PEERS,
+            "phases": [
+                {"rounds": 192, "append": 0},
+                {"rounds": 32, "crash": [2], "append": 0},
+                {"rounds": 96, "heal": True, "append": 0},
+            ],
+        }
+    plan = chaos.plan_from_dict(doc)
+    # election_tick=64: the free-running steady timer bound must clear the
+    # fused cadence horizon (the --lossy / prod-fused regime).
+    cfg = SimConfig(
+        n_groups=groups, n_peers=plan.n_peers, election_tick=64,
+        collect_health=True, transfer=True, commit_stall_ticks=8,
+    )
+    rng = np.random.RandomState(0)
+    append = jnp.asarray(
+        np.minimum(rng.zipf(1.8, size=groups), 8), dtype=jnp.int32
+    )
+    sim_sim = ClusterSim(cfg)
+    step = sim_sim._step
+    crashed0 = jnp.zeros((plan.n_peers, groups), bool)
+    st0 = sim_sim.state
+    for _ in range(3 * cfg.election_tick):
+        st0 = step(st0, crashed0, append, None, None, None, None)
+    jax.block_until_ready(st0)
+    st_keep = jax.tree.map(jnp.copy, st0)
+
+    def fresh_sim():
+        from raft_tpu.multiraft import sim as sim_mod
+
+        s = ClusterSim(cfg)
+        s.state = jax.tree.map(jnp.copy, st_keep)
+        s._health = sim_mod.init_health(cfg)
+        return s
+
+    apcfg = AutopilotConfig(cadence=cadence)
+    # Compile + policy warm-up run (jits cache inside the Autopilot; a
+    # fresh Autopilot per rep reuses nothing across them, so each rep
+    # carries one cold policy pass — build one runner cache to share).
+    warm = Autopilot(fresh_sim(), apcfg, fused=True)
+    report = warm.run_plan(plan, append=append)
+    shared_runners = warm._runners
+    samples = []
+    for _ in range(reps):
+        s = fresh_sim()
+        ap = Autopilot(s, apcfg, fused=True)
+        ap._runners = shared_runners
+        jax.block_until_ready(s.state)
+        t0 = time.perf_counter()
+        report = ap.run_plan(plan, append=append)
+        jax.block_until_ready(s.state)
+        samples.append(groups * plan.n_rounds / (time.perf_counter() - t0))
+    if any(report["safety"].values()):
+        print(
+            f"ERROR: autopilot bench violated safety invariants: "
+            f"{report['safety']}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f)
+    return {
+        "report": {
+            k: report[k]
+            for k in (
+                "rounds", "mttr_rounds", "reelections",
+                "commit_stall_group_rounds", "safety",
+            )
+        },
+        "actions": report["actions"],
+        **rep_stats(samples),
+        **fused_fields(
+            report.get("fused_rounds", 0) * reps,
+            groups * plan.n_rounds * reps,
+        ),
+    }
+
+
 def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
@@ -865,6 +974,10 @@ def main() -> None:
     ap.add_argument("--reconfig-out", default="", metavar="FILE")
     ap.add_argument("--prod-fused", default="", metavar="PLAN_JSON")
     ap.add_argument("--prod-out", default="", metavar="FILE")
+    ap.add_argument("--autopilot", action="store_true")
+    ap.add_argument("--autopilot-plan", default="", metavar="PLAN_JSON")
+    ap.add_argument("--autopilot-out", default="", metavar="FILE")
+    ap.add_argument("--cadence", type=int, default=16)
     ap.add_argument("--split-k", type=int, default=8)
     ap.add_argument("--split-window", type=int, default=4)
     ap.add_argument("--fused-floor", type=float, default=None)
@@ -907,6 +1020,31 @@ def main() -> None:
                 file=sys.stderr,
             )
             raise SystemExit(1)
+
+    if args.autopilot and (args.chaos or args.reconfig or args.prod_fused):
+        ap.error("--autopilot is its own mode (chaos via --autopilot-plan)")
+    if (args.autopilot_plan or args.autopilot_out) and not args.autopilot:
+        ap.error("--autopilot-plan/--autopilot-out require --autopilot")
+
+    if args.autopilot:
+        ap_stats = bench_autopilot(
+            args.groups, args.reps, args.autopilot_plan,
+            cadence=args.cadence, out=args.autopilot_out,
+        )
+        warn_spread("autopilot device", ap_stats)
+        line = {
+            "metric": "raft_autopilot_ticks_per_sec",
+            "value": ap_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            "autopilot": True,
+            **ap_stats,
+        }
+        print(json.dumps(line))
+        enforce_fused_floor(line)
+        if args.check:
+            run_check(args, line)
+        return
 
     if args.prod_fused:
         prod_stats = bench_prod_fused(

@@ -525,6 +525,21 @@ class Metrics:
             "(HealthMonitor.record_reconfig's stall detection)",
         )
 
+        # Autopilot plane (multiraft/autopilot.py): the closed control
+        # loop's issued actions and the transfer protocol's in-flight
+        # gauge.
+        self.autopilot_actions = r.counter(
+            "multiraft_autopilot_actions_total",
+            "Autopilot heal actions issued, by kind "
+            "(kicks / transfers / evacuations)",
+            ("kind",),
+        )
+        self.health_transfer_pending = r.gauge(
+            "health_groups_transfer_pending",
+            "Groups with a leader transfer currently pending "
+            "(lead_transferee set at the acting leader)",
+        )
+
     # --- tracing ---
 
     def trace(self, event: str, **fields) -> None:

@@ -39,11 +39,13 @@ from .simref import (
     HealthOracle,
     ReconfigOracle,
     ScalarCluster,
+    TransferOracle,
 )
 
 __all__ = [
     "ChaosOracle",
     "ReconfigOracle",
+    "TransferOracle",
     "committed_index",
     "committed_index_grouped",
     "joint_committed_index",
@@ -61,6 +63,7 @@ __all__ = [
     # submodules imported lazily to keep jax-light paths cheap:
     #   .chaos     fault-plan compiler + compiled-schedule runner
     #   .reconfig  membership-churn plan compiler + compiled-schedule runner
+    #   .autopilot closed-loop control plane (kick/transfer/evacuate)
     #   .driver    MultiRaft host driver
     #   .native    NativeMultiRaft C++ engine bindings
     #   .pallas_step  fused steady-round kernels

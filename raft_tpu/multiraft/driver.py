@@ -373,6 +373,42 @@ class MultiRaft:
     def campaign(self, g: int) -> None:
         self._host_op(g, lambda n: n.campaign())
 
+    def transfer_leader(self, g: int, transferee: int) -> None:
+        """Begin transferring group `g`'s leadership to peer `transferee`
+        (RawNode::transfer_leader — the autopilot's admin action on the
+        host driver path; the batched sim's twin is
+        sim.step(transfer_propose=))."""
+        self._host_op(g, lambda n: n.transfer_leader(transferee))
+
+    def transfer_pending(self) -> int:
+        """Groups with a leader transfer in flight (this node leading with
+        lead_transferee set); also published as the
+        health_groups_transfer_pending gauge when metrics are enabled."""
+        pending = sum(
+            1 for n in self.nodes if n.raft.lead_transferee is not None
+        )
+        m = self.metrics
+        if m is not None:
+            m.health_transfer_pending.set(pending)
+        return pending
+
+    def autopilot_report(self) -> Dict[str, object]:
+        """The driver-side autopilot surface: current transfer-pending
+        count, the MTTR facts (when health is on), and the most recent
+        autopilot flight-recorder entry from the attached monitor (the
+        batched Autopilot records its run reports there)."""
+        out: Dict[str, object] = {
+            "transfer_pending": self.transfer_pending(),
+        }
+        if self.health_config is not None:
+            out["mttr"] = self.mttr()
+        if self.health_monitor is not None:
+            for entry in reversed(self.health_monitor.flight_recorder()):
+                if "autopilot" in entry:
+                    out["last_run"] = entry["autopilot"]
+                    break
+        return out
+
     def has_ready(self, g: int) -> bool:
         return self.nodes[g].has_ready()
 

@@ -262,6 +262,32 @@ class HealthMonitor:
                 m.trace("reconfig.safety", **report["safety"])
         return entry
 
+    def record_autopilot(self, report: dict) -> dict:
+        """Fold an autopilot run report (Autopilot.run_plan's shape —
+        chaos_report plus commit_stall_group_rounds / end_counts /
+        actions) into the flight recorder and trace stream; actions and
+        safety violations each raise their own events so a healing run
+        can be audited from the trace alone."""
+        with self._lock:
+            entry = {"seq": self._seq, "ts": time.time(),
+                     "autopilot": report}
+            self._seq += 1
+            self._ring.append(entry)
+        m = self.metrics
+        if m is not None:
+            m.trace(
+                "autopilot.scenario",
+                rounds=report.get("rounds", 0),
+                mttr_rounds=report.get("mttr_rounds"),
+                commit_stall_group_rounds=report.get(
+                    "commit_stall_group_rounds", 0
+                ),
+                actions=report.get("actions", {}),
+            )
+            if any(report.get("safety", {}).values()):
+                m.trace("autopilot.safety", **report["safety"])
+        return entry
+
     def record_scenario(self, report: dict) -> dict:
         """Fold a chaos scenario report (chaos_report's shape) into the
         flight recorder and trace stream; safety violations raise a

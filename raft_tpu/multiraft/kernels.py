@@ -86,6 +86,21 @@ here is missing from it or untested under tests/.
                                simref.ReconfigOracle performs the
                                bit-identical scalar surgery —
                                tests/test_reconfig_parity.py
+  apply_transfer           <-> Raft.handle_transfer_leader — the leader-side
+                               MsgTransferLeader step (reference:
+                               raft.rs:1821-1889): validate the target
+                               (member, not learner, not self), abort a
+                               pending transfer to another target, reset
+                               the transfer clock; the catch-up append /
+                               MsgTimeoutNow pump it queues is
+                               sim._transfer_phase, parity vs the real
+                               RawNode::transfer_leader pump
+                               (simref.TransferOracle) in
+                               tests/test_transfer_batched.py
+  acting_leader_id         <-> ScalarCluster.acting_leader (the alive
+                               max-term leader; 0 = none) — the autopilot's
+                               per-group leader placement read, parity in
+                               tests/test_transfer_batched.py
   check_quorum_active      <-> tracker.ProgressTracker.quorum_recently_active
                                (reference: tracker.rs:346-372); the damped
                                round reads it at each leader's
@@ -613,9 +628,10 @@ def apply_confchange(
     removed: jnp.ndarray,  # gc: bool[P, G]
     apply_mask: jnp.ndarray,  # gc: bool[G]
     recent_active: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
+    transferee: Optional[jnp.ndarray] = None,  # gc: int32[P, G]
 ) -> Tuple[
     jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
-    jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray],
+    jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray], Optional[jnp.ndarray],
 ]:
     """Commit one validated conf change per selected group: swap the
     config mask planes and run the reference's apply-time reactions
@@ -649,8 +665,12 @@ def apply_confchange(
         happens here: the round's ordinary traffic propagates it.
 
     Returns (state', leader_id', commit', matched', voter', outgoing',
-    learner', recent_active'); recent_active passes through as None for
-    undamped states so the undamped pytree is unchanged.
+    learner', recent_active', transferee'); recent_active/transferee pass
+    through as None when absent so the legacy pytrees are unchanged.
+    `transferee` (the optional lead_transferee plane, SimConfig.transfer)
+    gets the reference's post_conf_change abort (raft.rs:1356): a pending
+    transfer whose target leaves the joint voter set — or whose owner is
+    stepped down by the change — is abandoned.
     """
     ap = apply_mask[None, :]  # [1, G]
     vm = jnp.where(ap, new_voter, voter_mask)
@@ -697,7 +717,97 @@ def apply_confchange(
         & (mci < INF)
     )
     commit2 = jnp.where(pickup, jnp.maximum(commit, mci), commit)
-    return state2, leader2, commit2, matched2, vm, om, lm, ra
+    if transferee is not None:
+        # post_conf_change's transfer abort (reference: raft.rs:1356):
+        # the pending target must remain in the joint voter set, and the
+        # owner must survive the change as leader.
+        P = transferee.shape[0]
+        joint_v = vm | om
+        tgt_in = jnp.take_along_axis(
+            joint_v, jnp.clip(transferee - 1, 0, P - 1), axis=0
+        )
+        tr = jnp.where(
+            ap & ((transferee > 0) & ~tgt_in | step_down), 0, transferee
+        )
+    else:
+        tr = None
+    return state2, leader2, commit2, matched2, vm, om, lm, ra, tr
+
+
+def apply_transfer(
+    transferee: jnp.ndarray,  # gc: int32[P, G]
+    election_elapsed: jnp.ndarray,  # gc: int32[P, G]
+    acting_leader: jnp.ndarray,  # gc: bool[P, G]
+    propose: jnp.ndarray,  # gc: int32[G]
+    member_mask: jnp.ndarray,  # gc: bool[P, G]
+    learner_mask: jnp.ndarray,  # gc: bool[P, G]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched leader-side MsgTransferLeader step (reference:
+    raft.rs:1821-1889 handle_transfer_leader), applied at each group's
+    acting leader.
+
+    propose[g] is the round's transfer command: the 1-based target peer id
+    (0 = none).  The reference's validation runs per group: the target
+    must be in the progress map (a member), must not be a learner, and
+    must not be the leader itself; a pending transfer to the SAME target
+    is left untouched (the retry pump nudges it), while a pending
+    transfer to a DIFFERENT target is aborted and replaced.  An accepted
+    command records the target in the leader's lead_transferee slot
+    (`transferee[leader, g]`) and resets the leader's election_elapsed —
+    the reference's "transfer should finish within one election timeout"
+    clock, whose expiry aborts the transfer at tick time.
+
+    What handle_transfer_leader QUEUES (the catch-up append when the
+    target lags, MsgTimeoutNow when it is caught up) is the caller's pump
+    — sim._transfer_phase models it round-by-round.
+
+    Returns (transferee', election_elapsed', accepted) with accepted
+    bool[G] marking groups whose command was newly recorded this round.
+    """
+    P = transferee.shape[0]
+    tgt = jnp.clip(propose - 1, 0, P - 1)[None, :]  # [1, G], 0-safe
+    tgt_member = jnp.take_along_axis(member_mask, tgt, axis=0)[0]
+    tgt_learner = jnp.take_along_axis(learner_mask, tgt, axis=0)[0]
+    # The acting leader's peer id and current lead_transferee, per group.
+    p_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1
+    lead_id = jnp.sum(
+        jnp.where(acting_leader, p_id, 0), axis=0, dtype=jnp.int32
+    )  # [G]
+    cur = jnp.sum(
+        jnp.where(acting_leader, transferee, 0), axis=0, dtype=jnp.int32
+    )  # [G]
+    checked = (propose > 0) & (lead_id > 0) & tgt_member & ~tgt_learner
+    accepted = checked & (propose != lead_id) & (propose != cur)
+    # Reference ordering quirk: a (member-valid) command naming the leader
+    # ITSELF aborts a pending transfer to another peer before the self
+    # check returns (the abort sits above it in handle_transfer_leader).
+    self_abort = checked & (propose == lead_id) & (cur > 0)
+    set_here = acting_leader & accepted[None, :]
+    transferee2 = jnp.where(
+        acting_leader & self_abort[None, :], 0, transferee
+    )
+    transferee2 = jnp.where(set_here, propose[None, :], transferee2)
+    ee2 = jnp.where(set_here, 0, election_elapsed)
+    return transferee2, ee2, accepted
+
+
+def acting_leader_id(
+    state: jnp.ndarray,  # gc: int32[P, G]
+    term: jnp.ndarray,  # gc: int32[P, G]
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+) -> jnp.ndarray:
+    """Per-group acting-leader peer id (1-based; 0 = no alive leader) —
+    the alive leader with the highest term, lowest peer index on the
+    (transient) tie, exactly ScalarCluster.acting_leader.  The autopilot's
+    leader-placement read: reduced on device, downloaded as one int32[G]
+    row at the drain cadence, never in the hot loop."""
+    P = state.shape[0]
+    is_lead = (state == ROLE_LEADER) & ~crashed
+    lead_term = jnp.max(jnp.where(is_lead, term, -1), axis=0)  # [G]
+    acting = is_lead & (term == lead_term[None, :])
+    p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]
+    first = jnp.min(jnp.where(acting, p_idx, P), axis=0)  # [G]
+    return jnp.where(jnp.any(is_lead, axis=0), first + 1, 0)
 
 
 def check_quorum_active(

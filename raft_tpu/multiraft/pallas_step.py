@@ -1445,6 +1445,15 @@ def steady_mask(
     # joint groups take the general XLA path)
     not_joint = ~jnp.any(st.outgoing_mask, axis=0)
     ok = no_campaign & one_leader & terms_ok & not_joint
+    if st.transferee is not None:
+        # 4b'. no pending leader transfer anywhere in the group (ISSUE
+        # 12): the fused kernel can neither pump the catch-up /
+        # MsgTimeoutNow protocol nor enforce the transfer's
+        # ProposalDropped gate, so a horizon containing one must take
+        # the general path.  The transferee plane rides through a fused
+        # block untouched (it is provably all-zero here); transfer-off
+        # states (transferee=None) keep every existing graph unchanged.
+        ok = ok & ~jnp.any(st.transferee > 0, axis=0)
     if reconfig_pending is not None:
         # 4b. no scheduled reconfig touches the horizon (see docstring).
         ok = ok & ~reconfig_pending

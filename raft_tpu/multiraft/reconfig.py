@@ -813,16 +813,25 @@ def _runner_body(
     sched: CompiledReconfig,
     chaos_sched: Optional[chaos_mod.CompiledChaos],
     with_counters: bool = False,
+    actions: Optional[Tuple] = None,
 ):
     """One general round of the compiled reconfig(+chaos) scenario as a
     lax.scan body over the absolute round index — the SINGLE source of the
     op propose/gate/apply protocol, shared by make_runner's whole-horizon
-    scan and make_split_runner's general segments / fused-block fallback.
+    scan, make_split_runner's general segments / fused-block fallback,
+    and the autopilot's cadence segments (autopilot.make_cadence_runner).
 
     Carry: (state, health, rstate, stats, rstats, safety) with an
     [N_COUNTERS] int32 plane appended when `with_counters` (the split
     runner's production configuration threads it; make_runner keeps the
-    historical carry and graph)."""
+    historical carry and graph).
+
+    `actions` (ISSUE 12, the autopilot's device-resident actuation) is an
+    optional (action_round, transfer_plane int32[G], kick_plane
+    bool[P, G]) triple: at the one round whose absolute index equals
+    `action_round` the transfer commands and campaign kicks are handed to
+    sim.step; every other round passes the zero action.  None keeps the
+    historical graphs byte-identical."""
     P, G = cfg.n_peers, cfg.n_groups
 
     def body(carry, r):
@@ -839,6 +848,14 @@ def _runner_body(
         else:
             link = None
             crashed = jnp.zeros((P, G), bool)
+        if actions is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            act_round, transfer_plane, kick_plane = actions
+            fire = r == act_round
+            transfer_propose = jnp.where(fire, transfer_plane, 0)
+            campaign_kick = kick_plane & fire
+        else:
+            transfer_propose = None
+            campaign_kick = None
         # Op eligibility: the next unapplied op, once its phase starts.
         start = _gather_op(sched.op_start, rst.op_ptr)
         active = (rst.op_ptr < sched.n_ops) & (r >= start)
@@ -849,6 +866,8 @@ def _runner_body(
             append + want_prop.astype(jnp.int32),
             counters=ctrs, health=hl, link=link,
             reconfig_propose=want_prop,
+            transfer_propose=transfer_propose,
+            campaign_kick=campaign_kick,
         )
         if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             st2, ctrs2, hl2, prop = step_out
@@ -895,7 +914,7 @@ def _runner_body(
         # The gated swap: target masks of the op being applied, the
         # reference's apply-time reactions on the batched planes.
         (
-            state3, leader3, commit3, matched3, vm3, om3, lm3, ra3,
+            state3, leader3, commit3, matched3, vm3, om3, lm3, ra3, tr3,
         ) = kernels.apply_confchange(
             st2.state, st2.leader_id, st2.commit, st2.term_start_index,
             st2.matched, st2.voter_mask, st2.outgoing_mask,
@@ -907,11 +926,12 @@ def _runner_body(
             _gather_op(sched.removed, rst.op_ptr),
             apply_mask,
             st2.recent_active,
+            st2.transferee,
         )
         st3 = st2._replace(
             state=state3, leader_id=leader3, commit=commit3,
             matched=matched3, voter_mask=vm3, outgoing_mask=om3,
-            learner_mask=lm3, recent_active=ra3,
+            learner_mask=lm3, recent_active=ra3, transferee=tr3,
         )
         stats = chaos_mod.update_chaos_stats(
             stats, prev_leaderless, hl2.planes[kernels.HP_LEADERLESS]

@@ -40,13 +40,16 @@ def make_mesh(
 
 
 def state_sharding(
-    mesh: Mesh, axis: str = "groups", damped: bool = False
+    mesh: Mesh, axis: str = "groups", damped: bool = False,
+    transfer: bool = False,
 ) -> SimState:
     """PartitionSpecs for every SimState field: the group axis (minor, the
     vector-lane axis of the peer-major [P, G] layout) is sharded; the peer
     axis stays local to the chip.  `damped` adds the spec for the
     recent_active [P, P, G] plane (present only when SimConfig damping is
-    on — it shards on G like the other pairwise planes)."""
+    on — it shards on G like the other pairwise planes); `transfer` the
+    spec for the lead_transferee [P, G] plane (SimConfig.transfer), which
+    shards on G like every other per-peer plane."""
     pg = NamedSharding(mesh, P(None, axis))
     ppg = NamedSharding(mesh, P(None, None, axis))
     return SimState(
@@ -56,12 +59,14 @@ def state_sharding(
         matched=ppg, term_start_index=pg, agree=ppg, voter_mask=pg,
         outgoing_mask=pg, learner_mask=pg,
         recent_active=ppg if damped else None,
+        transferee=pg if transfer else None,
     )
 
 
 def shard_state(state: SimState, mesh: Mesh, axis: str = "groups") -> SimState:
     shardings = state_sharding(
-        mesh, axis, damped=state.recent_active is not None
+        mesh, axis, damped=state.recent_active is not None,
+        transfer=state.transferee is not None,
     )
     return jax.tree.map(jax.device_put, state, shardings)
 
@@ -77,7 +82,8 @@ def sharded_step(
     partitions trivially along G.
     """
     shardings = state_sharding(
-        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote,
+        transfer=cfg.transfer,
     )
     crashed_sh = NamedSharding(mesh, P(None, axis))
     append_sh = NamedSharding(mesh, P(axis))
@@ -107,7 +113,8 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     state_specs = jax.tree.map(
         lambda s: s.spec,
         state_sharding(
-            mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+            mesh, axis, damped=cfg.check_quorum or cfg.pre_vote,
+            transfer=cfg.transfer,
         ),
     )
 
@@ -152,7 +159,8 @@ def sharded_read_index(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     cross-chip traffic — the consensus analog of a data-parallel inference
     step.  Returns a jitted fn (SimState, crashed[P, G]) -> int32[G]."""
     shardings = state_sharding(
-        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote
+        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote,
+        transfer=cfg.transfer,
     )
     crashed_sh = NamedSharding(mesh, P(None, axis))
     return jax.jit(

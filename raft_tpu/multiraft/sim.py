@@ -53,6 +53,18 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     pathology pinned (tests/test_chaos_parity.py) next to its damped
     collapse (tests/test_damping_parity.py).  The ReadIndex barrier is
     link-aware via read_index(link=).
+  * leader transfer (ISSUE 12): SimConfig(transfer=True) carries the
+    per-owner lead_transferee plane and `step(..., transfer_propose=)`
+    runs the raft-rs MsgTransferLeader / MsgTimeoutNow protocol as a
+    pre-tick pump (_transfer_phase, shared by all three step paths):
+    validation via kernels.apply_transfer, the probe-gated catch-up
+    append, the forced CAMPAIGN_TRANSFER election (no pre-vote, leases
+    bypassed), ProposalDropped while pending, and the tick-time
+    election-timeout abort — exact parity vs the real
+    RawNode::transfer_leader pump (simref.TransferOracle).
+    `step(..., campaign_kick=)` is the companion admin action (MsgHup
+    at tick time — RawNode::campaign).  Both are the autopilot's
+    actuation surface (raft_tpu/multiraft/autopilot.py).
   Not modeled on device (host path handles them): snapshots and entry
   payloads (the device sees cursor effects only) and ad-hoc conf changes
   OUTSIDE a compiled plan — a manual host-side mask swap still works but
@@ -128,6 +140,14 @@ class SimConfig(NamedTuple):
     # (damping-on rounds run the pairwise wave path, _damped_linked_step).
     check_quorum: bool = False
     pre_vote: bool = False
+    # Leader transfer (ISSUE 12): when True, SimState carries the per-owner
+    # lead_transferee plane (int32[P, G]) and step() accepts the
+    # `transfer_propose` / `campaign_kick` autopilot actions — the batched
+    # raft-rs MsgTransferLeader / MsgTimeoutNow protocol runs as a
+    # pre-tick pump (_transfer_phase) in all three step paths.  Trace-time
+    # static like the damping flags: the flag-off pytree and graphs are
+    # bit-identical to the pre-transfer build.
+    transfer: bool = False
 
     @property
     def min_timeout(self) -> int:
@@ -188,6 +208,15 @@ class SimState(NamedTuple):
     # election (become_leader's tracker reset).  bool[P, P, G] when
     # present.
     recent_active: Optional[jnp.ndarray] = None  # gc: bool[P, P, G]
+    # Per-OWNER lead_transferee (reference: raft.rs Raft.lead_transferee),
+    # present ONLY when SimConfig.transfer is on — None otherwise, so the
+    # transfer-off pytree (and its traced graphs) is bit-identical to the
+    # pre-transfer build.  transferee[owner, g] is the 1-based peer id the
+    # owner is transferring its leadership to (0 = none); non-zero only
+    # while the owner keeps leading at the recording term (every
+    # become_* path runs reset(), which aborts the transfer), values
+    # bounded by n_peers <= P (GC008 TRANSFER_PLANES registry).
+    transferee: Optional[jnp.ndarray] = None  # gc: int32[P, G]
 
 
 class HealthState(NamedTuple):
@@ -275,8 +304,10 @@ def init_state(
         if (cfg.check_quorum or cfg.pre_vote)
         else None
     )
+    transferee = jnp.zeros(shape, jnp.int32) if cfg.transfer else None
     return SimState(
         recent_active=recent_active,
+        transferee=transferee,
         term=zeros(),
         state=zeros(),
         vote=zeros(),
@@ -358,6 +389,423 @@ def _quorum_index(matched: jnp.ndarray, voter_mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(count == 0, kernels.INF, out)
 
 
+def _transfer_phase(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    transfer_propose: Optional[jnp.ndarray],  # gc: int32[G]
+    link: Optional[jnp.ndarray],  # gc: bool[P, P, G]
+    group_ids: Optional[jnp.ndarray] = None,
+) -> Tuple[SimState, jnp.ndarray, jnp.ndarray]:
+    """The pre-tick leader-transfer pump, shared by all three step paths.
+
+    One round of the drain-cadence transfer protocol, exactly the scalar
+    pump the TransferOracle drives (simref.TransferOracle): BEFORE the
+    round's ticks, each group's acting leader (1) steps this round's
+    MsgTransferLeader command if `transfer_propose[g]` names a target
+    (kernels.apply_transfer — the reference's validation + transfer-clock
+    reset, raft.rs:1821-1889), then (2) pumps its pending transfer: a
+    catch-up append to the transferee (allow_empty, so an already
+    caught-up target is probed too), whose ack shows the target caught up
+    and triggers MsgTimeoutNow — or MsgTimeoutNow directly when a NEW
+    command finds the target already caught up (no ack round trip, so a
+    one-way leader->target link suffices there).  The transferee receiving
+    MsgTimeoutNow campaigns immediately with CAMPAIGN_TRANSFER (hup(true),
+    raft.rs:2257-2354): no pre-vote probe, leases bypassed by the force
+    context (raft.rs:1280-1348), and the whole forced election — vote
+    requests, grants/rejections with the scalar response-order cutoffs,
+    commit fast-forwards, the winner's noop append/broadcast/quorum-commit
+    — resolves inside this same pump, like any reachable scalar transfer
+    completes within one pumped round.
+
+    Every hop is gated per DIRECTED link (the chaos plane): an
+    unreachable transferee leaves the transfer pending (proposals stay
+    blocked at the leader until the tick-time election-timeout abort),
+    and a one-way target->leader cut delivers the catch-up append but
+    never the ack, so MsgTimeoutNow is withheld — the raft-rs behavior.
+
+    Returns (state', campaigned[G], won[G]) — the transfer-campaign and
+    transfer-win facts the caller folds into counters/health (the scalar
+    side counts the hup(true) campaign() call and the become_leader).
+    Under damping (check_quorum/pre_vote) the catch-up append reaching a
+    HIGHER-term target draws the low-term nudge, deposing the stale
+    leader (and aborting the transfer) exactly like the reference.
+    """
+    G, P = cfg.n_groups, cfg.n_peers
+    damped = cfg.check_quorum or cfg.pre_vote
+    self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
+    p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
+    alive = ~crashed
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    if link is None:
+        E = alive[:, None, :] & alive[None, :, :] & off_diag
+    else:
+        E = link & alive[:, None, :] & alive[None, :, :] & off_diag
+    node_key = _node_key(cfg, group_ids)
+    lo = jnp.full((P, G), cfg.min_timeout, jnp.int32)
+    hi = jnp.full((P, G), cfg.max_timeout, jnp.int32)
+
+    def draw(term):
+        return kernels.timeout_draw(node_key, term.astype(jnp.uint32), lo, hi)
+
+    promotable = st.voter_mask | st.outgoing_mask
+    member = promotable | st.learner_mask
+
+    # ---- the acting leader, pre-round (the scalar pump steps the command
+    # at the alive max-term leader; ties resolve to the lowest index).
+    is_lead = (st.state == ROLE_LEADER) & alive
+    has_lead = jnp.any(is_lead, axis=0)  # [G]
+    lead_term = jnp.max(jnp.where(is_lead, st.term, -1), axis=0)  # [G]
+    acting = is_lead & (st.term == lead_term[None, :])
+    first_l = jnp.min(jnp.where(acting, p_idx, P), axis=0)  # [G]
+    is_acting = (p_idx == first_l) & has_lead[None, :]
+    acting_i = is_acting.astype(jnp.int32)
+
+    if transfer_propose is None:
+        transfer_propose = jnp.zeros((G,), jnp.int32)
+    T, ee0, accepted = kernels.apply_transfer(
+        st.transferee, st.election_elapsed, is_acting, transfer_propose,
+        member, st.learner_mask,
+    )
+
+    # The acting leader's pending target, post-command; everything below
+    # is masked on `active` so transfer-free groups are untouched.
+    t_all = jnp.sum(jnp.where(is_acting, T, 0), axis=0, dtype=jnp.int32)
+    active = has_lead & (t_all > 0)  # [G]
+    is_tgt = (self_id == t_all[None, :]) & active[None, :]  # [P, G]
+
+    lead_last = jnp.sum(st.last_index * acting_i, axis=0, dtype=jnp.int32)
+    lead_lterm = jnp.sum(st.last_term * acting_i, axis=0, dtype=jnp.int32)
+    lead_commit = jnp.sum(st.commit * acting_i, axis=0, dtype=jnp.int32)
+    m_row = jnp.sum(
+        st.matched * acting_i[:, None, :], axis=0, dtype=jnp.int32
+    )  # [P, G]: the leader's tracker row
+    agree_lead = jnp.sum(
+        st.agree * acting_i[:, None, :], axis=0, dtype=jnp.int32
+    )  # [P, G]: agree[leader, :]
+    matched_t = jnp.sum(
+        jnp.where(is_tgt, m_row, 0), axis=0, dtype=jnp.int32
+    )  # [G]
+    caught_pre = matched_t == lead_last
+    term_t = jnp.sum(jnp.where(is_tgt, st.term, 0), axis=0, dtype=jnp.int32)
+
+    # Directed leader<->target links.
+    E_lt = jnp.any(E & is_acting[:, None, :] & is_tgt[None, :, :], axis=(0, 1))
+    E_tl = jnp.any(E & is_tgt[:, None, :] & is_acting[None, :, :], axis=(0, 1))
+
+    # ---- hop 1: MsgTimeoutNow directly (new command, target caught up —
+    # reference: handle_transfer_leader's matched == last_index branch) or
+    # the catch-up append (allow_empty=True: the pending-transfer nudge).
+    tn_direct = active & accepted & caught_pre & E_lt
+    ap_path = active & ~(accepted & caught_pre)
+    del_ap = ap_path & E_lt & (term_t <= lead_term)
+    # Log+commit adoption needs the probe to MATCH (the target's
+    # agreement with the leader covers the append's prev entry) or a
+    # live reverse link for the reject/retry chain to converge within
+    # the pump — the same gate _linked_step applies to workload appends;
+    # a delivered-but-rejected append still resets timers and follower
+    # state (message receipt), it just adopts nothing.
+    lead_ts = jnp.sum(
+        st.term_start_index * acting_i, axis=0, dtype=jnp.int32
+    )
+    prev_t = jnp.where(matched_t == 0, lead_ts - 1, lead_last)
+    agree_lt = jnp.sum(
+        jnp.where(is_tgt, agree_lead, 0), axis=0, dtype=jnp.int32
+    )  # [G]: agree[leader, target]
+    adopt_ap = del_ap & ((agree_lt >= prev_t) | E_tl)
+    sync = is_tgt & del_ap[None, :]
+    adopt = is_tgt & adopt_ap[None, :]
+    bump = sync & (st.term < lead_term[None, :])
+    T_pl = jnp.where(sync, lead_term[None, :], st.term)
+    St_pl = jnp.where(sync, ROLE_FOLLOWER, st.state)
+    V_pl = jnp.where(bump, 0, st.vote)
+    Ld_pl = jnp.where(sync, first_l[None, :] + 1, st.leader_id)
+    EE_pl = jnp.where(sync, 0, ee0)
+    HB_pl = st.heartbeat_elapsed
+    RT_pl = jnp.where(bump, draw(T_pl), st.randomized_timeout)
+    LI_pl = jnp.where(adopt, lead_last[None, :], st.last_index)
+    LT_pl = jnp.where(adopt, lead_lterm[None, :], st.last_term)
+    C_pl = jnp.where(
+        adopt, jnp.maximum(st.commit, lead_commit[None, :]), st.commit
+    )
+    in_s = adopt | (is_acting & adopt_ap[None, :])
+    agree_pl = jnp.where(
+        in_s[:, None, :] & in_s[None, :, :],
+        lead_last[None, None, :],
+        jnp.where(
+            in_s[:, None, :],
+            agree_lead[None, :, :],
+            jnp.where(in_s[None, :, :], agree_lead[:, None, :], st.agree),
+        ),
+    )
+    ack = adopt_ap & E_tl
+    mack = is_acting[:, None, :] & is_tgt[None, :, :] & ack[None, None, :]
+    matched_pl = jnp.where(mack, lead_last[None, None, :], st.matched)
+    RA = st.recent_active
+    if RA is not None:
+        RA = jnp.where(mack, True, RA)
+    if damped:
+        # The low-term nudge: the catch-up append reaching a higher-term
+        # target draws an empty MsgAppendResponse at the target's term,
+        # deposing the stale leader (reference: raft.rs:1280-1348's
+        # m.term < self.term branch) — reset() aborts the transfer.
+        ndg = ap_path & E_lt & (term_t > lead_term) & E_tl
+        dep = is_acting & ndg[None, :]
+        T_pl = jnp.where(dep, term_t[None, :], T_pl)
+        St_pl = jnp.where(dep, ROLE_FOLLOWER, St_pl)
+        V_pl = jnp.where(dep, 0, V_pl)
+        Ld_pl = jnp.where(dep, 0, Ld_pl)
+        EE_pl = jnp.where(dep, 0, EE_pl)
+        HB_pl = jnp.where(dep, 0, HB_pl)
+        RT_pl = jnp.where(dep, draw(T_pl), RT_pl)
+        T = jnp.where(dep, 0, T)
+
+    # ---- hop 2: MsgTimeoutNow at the target.  A lower-term target first
+    # takes the generic become_follower(m.term) bump; then a FOLLOWER at
+    # the leader's term hups — candidates and leaders at that term ignore
+    # it (step_candidate/step_leader), exactly the reference dispatch.
+    # The ack-triggered send fires only when the ack made PROGRESS
+    # (handle_append_response early-returns on maybe_update(m.index) ==
+    # false, so an already-caught-up transferee's empty-append ack never
+    # re-sends a lost MsgTimeoutNow — the transfer hangs until the
+    # tick-time abort, the reference behavior).
+    tn = tn_direct | (ack & (matched_t < lead_last))
+    tn_bump = is_tgt & tn[None, :] & (T_pl < lead_term[None, :])
+    T_pl = jnp.where(tn_bump, lead_term[None, :], T_pl)
+    St_pl = jnp.where(tn_bump, ROLE_FOLLOWER, St_pl)
+    V_pl = jnp.where(tn_bump, 0, V_pl)
+    Ld_pl = jnp.where(tn_bump, 0, Ld_pl)
+    EE_pl = jnp.where(tn_bump, 0, EE_pl)
+    HB_pl = jnp.where(tn_bump, 0, HB_pl)
+    RT_pl = jnp.where(tn_bump, draw(T_pl), RT_pl)
+    campaign_mask = (
+        is_tgt
+        & tn[None, :]
+        & (St_pl == ROLE_FOLLOWER)
+        & (T_pl == lead_term[None, :])
+        & promotable
+    )
+    cg = jnp.any(campaign_mask, axis=0)  # [G]
+
+    # ---- the forced campaign (CAMPAIGN_TRANSFER skips pre-vote even when
+    # cfg.pre_vote is on; reference: hup raft.rs:1472-1525).
+    t_star = lead_term + 1  # [G]
+    T_pl = jnp.where(campaign_mask, t_star[None, :], T_pl)
+    St_pl = jnp.where(campaign_mask, ROLE_CANDIDATE, St_pl)
+    V_pl = jnp.where(campaign_mask, self_id, V_pl)
+    Ld_pl = jnp.where(campaign_mask, 0, Ld_pl)
+    EE_pl = jnp.where(campaign_mask, 0, EE_pl)
+    HB_pl = jnp.where(campaign_mask, 0, HB_pl)
+    RT_pl = jnp.where(campaign_mask, draw(T_pl), RT_pl)
+
+    # ---- hop 3: the transfer election.  Vote requests reach every voter
+    # over the target's outbound links; the force context bypasses leases
+    # and a real request at a lower term is silently ignored by
+    # higher-term voters (no nudge for real votes), so delivery reduces
+    # to the masks below.  The candidate's log is its post-catch-up log.
+    E_from_t = jnp.any(E & is_tgt[:, None, :], axis=0)  # [P_v, G]
+    E_to_t = jnp.any(E & is_tgt[None, :, :], axis=1)  # [P_v, G]
+    del_rq = cg[None, :] & promotable & ~is_tgt & E_from_t
+    li_t = jnp.sum(jnp.where(is_tgt, LI_pl, 0), axis=0, dtype=jnp.int32)
+    lt_t = jnp.sum(jnp.where(is_tgt, LT_pl, 0), axis=0, dtype=jnp.int32)
+    c_t = jnp.sum(jnp.where(is_tgt, C_pl, 0), axis=0, dtype=jnp.int32)
+    agree_t = jnp.sum(
+        agree_pl * is_tgt.astype(jnp.int32)[:, None, :],
+        axis=0,
+        dtype=jnp.int32,
+    )  # [P_v, G]: agree[target, v]
+    vbump = del_rq & (T_pl < t_star[None, :])
+    at = del_rq & (T_pl <= t_star[None, :])
+    T_pl = jnp.where(vbump, t_star[None, :], T_pl)
+    St_pl = jnp.where(vbump, ROLE_FOLLOWER, St_pl)
+    V_pl = jnp.where(vbump, 0, V_pl)
+    Ld_pl = jnp.where(vbump, 0, Ld_pl)
+    EE_pl = jnp.where(vbump, 0, EE_pl)
+    HB_pl = jnp.where(vbump, 0, HB_pl)
+    RT_pl = jnp.where(vbump, draw(T_pl), RT_pl)
+    up = (lt_t[None, :] > LT_pl) | (
+        (lt_t[None, :] == LT_pl) & (li_t[None, :] >= LI_pl)
+    )
+    can = at & (((V_pl == 0) & (Ld_pl == 0)) | (V_pl == t_all[None, :]))
+    grant = can & up
+    rej = at & ~grant
+    rej_snap = C_pl  # reject responses snapshot commit BEFORE the vff
+    # Voter-side maybe_commit_by_vote off the request's commit info
+    # (reference: raft.rs:2126-2164; leaders skip).
+    vff = (
+        rej
+        & (St_pl != ROLE_LEADER)
+        & (c_t[None, :] > C_pl)
+        & (c_t[None, :] <= agree_t)
+    )
+    V_pl = jnp.where(grant, t_all[None, :], V_pl)
+    EE_pl = jnp.where(grant, 0, EE_pl)
+    C_pl = jnp.where(vff, c_t[None, :], C_pl)
+
+    # ---- hop 4: responses back in voter order with the scalar win/loss
+    # cutoffs (raft.rs:2184-2190 + 2236-2247), candidate-side commit
+    # fast-forward included.
+    n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+    n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+    q_i = n_i // 2 + 1
+    q_o = n_o // 2 + 1
+    vm_t = jnp.sum(
+        jnp.where(is_tgt, st.voter_mask, False), axis=0, dtype=jnp.int32
+    )
+    om_t = jnp.sum(
+        jnp.where(is_tgt, st.outgoing_mask, False), axis=0, dtype=jnp.int32
+    )
+    cnt_i = jnp.where(cg, vm_t, 0)  # the self-vote
+    cnt_o = jnp.where(cg, om_t, 0)
+    rec_i = cnt_i
+    rec_o = cnt_o
+    ff = jnp.zeros((G,), jnp.int32)
+    del_g = grant & E_to_t
+    del_r = rej & E_to_t
+    for v in range(P):
+        won_before = ((cnt_i >= q_i) | (n_i == 0)) & (
+            (cnt_o >= q_o) | (n_o == 0)
+        )
+        lost_before = ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i)) | (
+            (n_o > 0) & (cnt_o + (n_o - rec_o) < q_o)
+        )
+        ok = del_r[v] & ~won_before & ~lost_before & (rej_snap[v] <= agree_t[v])
+        ff = jnp.where(ok, jnp.maximum(ff, rej_snap[v]), ff)
+        resp_v = del_g[v] | del_r[v]
+        rec_i = rec_i + (resp_v & st.voter_mask[v]).astype(jnp.int32)
+        rec_o = rec_o + (resp_v & st.outgoing_mask[v]).astype(jnp.int32)
+        cnt_i = cnt_i + (del_g[v] & st.voter_mask[v]).astype(jnp.int32)
+        cnt_o = cnt_o + (del_g[v] & st.outgoing_mask[v]).astype(jnp.int32)
+    won_t = cg & ((cnt_i >= q_i) | (n_i == 0)) & ((cnt_o >= q_o) | (n_o == 0))
+    lost_t = (
+        cg
+        & ~won_t
+        & (
+            ((n_i > 0) & (cnt_i + (n_i - rec_i) < q_i))
+            | ((n_o > 0) & (cnt_o + (n_o - rec_o) < q_o))
+        )
+    )
+    C_pl = jnp.where(
+        is_tgt & cg[None, :], jnp.maximum(C_pl, ff[None, :]), C_pl
+    )
+
+    # ---- hop 5: the winner's become_leader + noop append + broadcast +
+    # quorum commit + commit re-broadcast; a decided loser steps down at
+    # t_star (become_follower — same-term reset keeps its self-vote).
+    win_mask = is_tgt & won_t[None, :]
+    lose_mask = is_tgt & lost_t[None, :]
+    St_pl = jnp.where(win_mask, ROLE_LEADER, St_pl)
+    Ld_pl = jnp.where(win_mask, self_id, Ld_pl)
+    EE_pl = jnp.where(win_mask | lose_mask, 0, EE_pl)
+    HB_pl = jnp.where(win_mask | lose_mask, 0, HB_pl)
+    St_pl = jnp.where(lose_mask, ROLE_FOLLOWER, St_pl)
+    Ld_pl = jnp.where(lose_mask, 0, Ld_pl)
+    LI_pl = LI_pl + win_mask.astype(jnp.int32)  # the noop entry
+    LT_pl = jnp.where(win_mask, t_star[None, :], LT_pl)
+    TS_pl = jnp.where(win_mask, LI_pl, st.term_start_index)
+    matched_pl = jnp.where(win_mask[:, None, :], 0, matched_pl)
+    c_t_bcast = jnp.sum(
+        jnp.where(is_tgt, C_pl, 0), axis=0, dtype=jnp.int32
+    )  # the noop broadcast's carried commit (pre-quorum-commit)
+    noop_last = jnp.sum(
+        jnp.where(win_mask, LI_pl, 0), axis=0, dtype=jnp.int32
+    )
+    noop_prev = noop_last - 1  # every voter synced to it pre-noop
+    del_nb = (
+        won_t[None, :] & member & ~is_tgt & E_from_t
+        & (T_pl <= t_star[None, :])
+    )
+    # Probe gate (the reference's progress model): the noop append's prev
+    # entry must match — voters that granted hold the caught-up log; a
+    # member whose log diverges below the prev is synced by the wholesale
+    # adoption model only if its agreement with the target reaches prev.
+    nb_ok = del_nb & (
+        (agree_t >= noop_prev[None, :]) | E_to_t
+    )
+    nb_bump = nb_ok & (T_pl < t_star[None, :])
+    T_pl = jnp.where(nb_ok, t_star[None, :], T_pl)
+    St_pl = jnp.where(nb_ok, ROLE_FOLLOWER, St_pl)
+    V_pl = jnp.where(nb_bump, 0, V_pl)
+    Ld_pl = jnp.where(nb_ok, t_all[None, :], Ld_pl)
+    EE_pl = jnp.where(nb_ok, 0, EE_pl)
+    HB_pl = jnp.where(nb_bump, 0, HB_pl)
+    RT_pl = jnp.where(nb_bump, draw(T_pl), RT_pl)
+    LI_pl = jnp.where(nb_ok, noop_last[None, :], LI_pl)
+    LT_pl = jnp.where(nb_ok, t_star[None, :], LT_pl)
+    C_pl = jnp.where(nb_ok, jnp.maximum(C_pl, c_t_bcast[None, :]), C_pl)
+    in_nb = nb_ok | win_mask
+    agree_row_t = agree_t  # agree[target, :] before the broadcast
+    agree_pl = jnp.where(
+        in_nb[:, None, :] & in_nb[None, :, :],
+        noop_last[None, None, :],
+        jnp.where(
+            in_nb[:, None, :],
+            agree_row_t[None, :, :],
+            jnp.where(in_nb[None, :, :], agree_row_t[:, None, :], agree_pl),
+        ),
+    )
+    ack_nb = nb_ok & E_to_t
+    acked_m = ack_nb | win_mask  # the winner's own persisted noop
+    matched_pl = jnp.where(
+        is_tgt[:, None, :] & acked_m[None, :, :] & won_t[None, None, :],
+        noop_last[None, None, :],
+        matched_pl,
+    )
+    if RA is not None:
+        # become_leader's wholesale tracker reset (self-only row), then
+        # the noop acks mark the responders recently active.
+        eye_pp = jnp.eye(P, dtype=bool)[:, :, None]
+        RA = jnp.where(is_tgt[:, None, :] & won_t[None, None, :], eye_pp, RA)
+        RA = jnp.where(
+            is_tgt[:, None, :] & ack_nb[None, :, :] & won_t[None, None, :],
+            True,
+            RA,
+        )
+    row_t = jnp.sum(
+        matched_pl * is_tgt.astype(jnp.int32)[:, None, :],
+        axis=0,
+        dtype=jnp.int32,
+    )  # [P, G]
+    mci = jnp.minimum(
+        _quorum_index(row_t, st.voter_mask),
+        _quorum_index(row_t, st.outgoing_mask),
+    )
+    commit_ok = won_t & (mci >= noop_last) & (mci < kernels.INF)
+    c_t_new = jnp.where(
+        commit_ok, jnp.maximum(c_t_bcast, mci), c_t_bcast
+    )
+    C_pl = jnp.where(is_tgt & won_t[None, :], c_t_new[None, :], C_pl)
+    # The commit-advance re-broadcast is itself an append: a member whose
+    # noop ack was LOST leaves its fresh probe paused (no ack since the
+    # winner's tracker reset), so only acked members learn the settled
+    # commit — the raft-rs pause discipline, same as the workload phase's
+    # pr_ok gate.
+    C_pl = jnp.where(ack_nb, jnp.maximum(C_pl, c_t_new[None, :]), C_pl)
+
+    # reset-abort invariant: lead_transferee survives only while its
+    # owner keeps leading (every become_* path runs reset(), which clears
+    # it — raft.rs:942-971).
+    T = jnp.where(St_pl == ROLE_LEADER, T, 0)
+    out = st._replace(
+        term=T_pl,
+        state=St_pl,
+        vote=V_pl,
+        leader_id=Ld_pl,
+        election_elapsed=EE_pl,
+        heartbeat_elapsed=HB_pl,
+        randomized_timeout=RT_pl,
+        last_index=LI_pl,
+        last_term=LT_pl,
+        commit=C_pl,
+        matched=matched_pl,
+        term_start_index=TS_pl,
+        agree=agree_pl,
+        recent_active=RA,
+        transferee=T,
+    )
+    return out, cg, won_t
+
+
 def step(
     cfg: SimConfig,
     st: SimState,
@@ -368,6 +816,8 @@ def step(
     health: Optional[HealthState] = None,  # gc: HealthState
     link: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
+    transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
+    campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -415,6 +865,12 @@ def step(
     replay expresses; with both flags False this dispatch (and the traced
     graph) is unchanged.
     """
+    if transfer_propose is not None and st.transferee is None:
+        raise ValueError(
+            "step(transfer_propose=) needs the lead_transferee plane — "
+            "construct the sim with SimConfig(transfer=True) (init_state "
+            "creates it); the transfer-off pytree/graphs stay pinned"
+        )
     if cfg.check_quorum or cfg.pre_vote:
         if link is None:
             link = jnp.ones(
@@ -422,14 +878,27 @@ def step(
             )
         return _damped_linked_step(
             cfg, st, crashed, append_n, link, group_ids, counters, health,
-            reconfig_propose,
+            reconfig_propose, transfer_propose, campaign_kick,
         )
     if link is not None:
         return _linked_step(
             cfg, st, crashed, append_n, link, group_ids, counters, health,
-            reconfig_propose,
+            reconfig_propose, transfer_propose, campaign_kick,
         )
     G, P = cfg.n_groups, cfg.n_peers
+    # Leader-transfer pre-tick pump (ISSUE 12): runs the pending/new
+    # transfer commands to quiescence BEFORE the round's ticks, exactly
+    # where the scalar TransferOracle pumps them; the round's protocol
+    # phases below then run on the post-transfer state while the
+    # counter/health extras keep the ORIGINAL pre-round baseline (the
+    # scalar facts span the whole round, transfer included).
+    st_in = st
+    t_extra = None
+    if st.transferee is not None:
+        st, t_campaigned, t_won = _transfer_phase(
+            cfg, st, crashed, transfer_propose, None, group_ids
+        )
+        t_extra = (t_campaigned, t_won)
     self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
     alive = ~crashed
     node_key = _node_key(cfg, group_ids)
@@ -446,7 +915,7 @@ def step(
     # (voters + learners) are who the leader replicates to.
     promotable = st.voter_mask | st.outgoing_mask
     member = promotable | st.learner_mask
-    ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
+    ee, hb, want_campaign, want_heartbeat, want_cq = kernels.tick_kernel(
         st.state,
         st.election_elapsed,
         st.heartbeat_elapsed,
@@ -455,6 +924,20 @@ def step(
         cfg.election_tick,
         cfg.heartbeat_tick,
     )
+    if campaign_kick is not None:
+        # Autopilot campaign kick: a MsgHup stepped at tick time (the
+        # RawNode::campaign admin call) — a kicked promotable non-leader
+        # campaigns NOW, through the ordinary election machinery (hup
+        # resets the election clock via become_candidate's reset).
+        kicked = campaign_kick & (st.state != ROLE_LEADER) & promotable
+        want_campaign = want_campaign | kicked
+        ee = jnp.where(kicked, 0, ee)
+    transferee = st.transferee
+    if transferee is not None:
+        # Tick-time transfer abort (reference: raft.rs:1051-1079): the
+        # transfer clock expiring at the leader's election-timeout
+        # boundary abandons the pending transfer.
+        transferee = jnp.where(want_cq, 0, transferee)
 
     # ---- Phase B: campaigners become candidates (reference:
     # raft.rs:1101-1117): term+1, vote self, redraw timeout.
@@ -772,6 +1255,14 @@ def step(
 
     # Append workload at the leader (entries stamped with its term).
     n_app = jnp.where(has_leader, append_n, 0)  # [G]
+    if transferee is not None:
+        # Proposals are dropped while a transfer is pending at the acting
+        # leader (reference: raft.rs:1956-2123 step_leader's
+        # lead_transferee ProposalDropped).
+        blocked = jnp.any(is_acting_leader & (transferee > 0), axis=0)
+        n_app = jnp.where(blocked, 0, n_app)
+    else:
+        blocked = None
     new_last_index = new_last_index + jnp.where(is_acting_leader, n_app, 0)
     new_last_term = jnp.where(is_acting_leader, lead_term, new_last_term)
 
@@ -849,6 +1340,10 @@ def step(
     # outran a stale leader are kept.
     commit = jnp.where(sync, jnp.maximum(commit, lead_commit), commit)
 
+    if transferee is not None:
+        # reset-abort invariant: any owner that stopped leading this
+        # round ran reset() on the scalar side, clearing lead_transferee.
+        transferee = jnp.where(state_d == ROLE_LEADER, transferee, 0)
     out = SimState(
         term=term_d,
         state=state_d,
@@ -866,6 +1361,8 @@ def step(
         voter_mask=st.voter_mask,
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
+        recent_active=st.recent_active,
+        transferee=transferee,
     )
     if counters is None and health is None and reconfig_propose is None:
         return out
@@ -876,10 +1373,21 @@ def step(
     won_any = winner_exists | jnp.any(solo_win, axis=0)
     extras: Tuple = ()
     if counters is not None:
-        # Device-side event counting, fused into this same dispatch.
+        # Device-side event counting, fused into this same dispatch; the
+        # baseline is the PRE-transfer state so a transfer's commit
+        # advances count, and the transfer campaign/win join the
+        # campaign()/become_leader tallies like their scalar twins.
         counters = kernels.count_events(
-            counters, want_campaign, want_heartbeat, won_any, commit - st.commit
+            counters, want_campaign, want_heartbeat, won_any,
+            commit - st_in.commit,
         )
+        if t_extra is not None:
+            counters = counters.at[kernels.CTR_CAMPAIGNS].add(
+                jnp.sum(t_extra[0], dtype=jnp.int32)
+            )
+            counters = counters.at[kernels.CTR_ELECTIONS_WON].add(
+                jnp.sum(t_extra[1], dtype=jnp.int32)
+            )
         extras = extras + (counters,)
     if health is not None:
         # Device-side per-group health fold, fused into this same dispatch.
@@ -888,9 +1396,23 @@ def step(
         # identical facts from observable scalar state
         # (simref.HealthOracle — exact parity, tests/test_health_parity.py).
         has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
-        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
-        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(
+            st_in.commit, axis=0
+        )
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st_in.term, axis=0)
         campaigned = jnp.any(want_campaign, axis=0)
+        if t_extra is None:
+            won_h = won_any
+        else:
+            # With a transfer phase in the round, `won` is the oracle's
+            # OBSERVED end-of-round fact (a transfer winner deposed by
+            # the tick election later in the same round does not count) —
+            # the same rule the damped path already mirrors.
+            won_h = jnp.any(
+                (out.state == ROLE_LEADER)
+                & ((st_in.state != ROLE_LEADER) | (out.term > st_in.term)),
+                axis=0,
+            )
         planes, pos = kernels.update_health(
             health.planes,
             health.window_pos,
@@ -898,11 +1420,15 @@ def step(
             has_lead_end,
             commit_adv,
             term_bump,
-            campaigned & ~won_any,
+            campaigned & ~won_h,
         )
         extras = extras + (HealthState(planes, pos),)
     if reconfig_propose is not None:
         prop_mask = has_leader & reconfig_propose
+        if blocked is not None:
+            # A pending transfer drops the conf entry with the rest of
+            # the batch (ProposalDropped); owner 0 makes the op retry.
+            prop_mask = prop_mask & ~blocked
         extras = extras + (
             ReconfigProposal(
                 owner=jnp.where(prop_mask, first_l + 1, 0),
@@ -923,6 +1449,8 @@ def _linked_step(
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
+    transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
+    campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
 ) -> Union[SimState, Tuple]:
     """The pairwise (link-gated) protocol round behind `step(..., link=)`.
 
@@ -956,6 +1484,14 @@ def _linked_step(
     alongside per-round oracle parity (simref.ChaosOracle).
     """
     G, P = cfg.n_groups, cfg.n_peers
+    st_in = st
+    t_extra = None
+    if st.transferee is not None:
+        # The transfer pre-tick pump, link-gated (see _transfer_phase).
+        st, t_campaigned, t_won = _transfer_phase(
+            cfg, st, crashed, transfer_propose, link, group_ids
+        )
+        t_extra = (t_campaigned, t_won)
     self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1  # [P, 1]
     p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]  # [P, 1]
     alive = ~crashed
@@ -971,7 +1507,7 @@ def _linked_step(
 
     promotable = st.voter_mask | st.outgoing_mask
     member = promotable | st.learner_mask
-    ee, hb, want_campaign, want_heartbeat, _ = kernels.tick_kernel(
+    ee, hb, want_campaign, want_heartbeat, want_cq = kernels.tick_kernel(
         st.state,
         st.election_elapsed,
         st.heartbeat_elapsed,
@@ -980,6 +1516,16 @@ def _linked_step(
         cfg.election_tick,
         cfg.heartbeat_tick,
     )
+
+    if campaign_kick is not None:
+        # Autopilot campaign kick (MsgHup at tick time; see step()).
+        kicked = campaign_kick & (st.state != ROLE_LEADER) & promotable
+        want_campaign = want_campaign | kicked
+        ee = jnp.where(kicked, 0, ee)
+    transferee = st.transferee
+    if transferee is not None:
+        # Tick-time transfer abort (reference: raft.rs:1051-1079).
+        transferee = jnp.where(want_cq, 0, transferee)
 
     # ---- campaign side effects are local (reference: raft.rs:1101-1117);
     # isolation cuts the network, never the clock.
@@ -1365,6 +1911,13 @@ def _linked_step(
     first_l = jnp.min(jnp.where(is_acting, p_idx, P), axis=0)
     is_acting_leader = (p_idx == first_l) & has_leader
     n_app = jnp.where(has_leader, append_n, 0)
+    if transferee is not None:
+        # ProposalDropped while a transfer is pending at the acting
+        # leader (reference: raft.rs step_leader's lead_transferee gate).
+        blocked = jnp.any(is_acting_leader & (transferee > 0), axis=0)
+        n_app = jnp.where(blocked, 0, n_app)
+    else:
+        blocked = None
     sent_b = has_leader & (n_app > 0)
     lead_pre_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
     LI = LI + jnp.where(is_acting_leader, n_app, 0)
@@ -1442,6 +1995,10 @@ def _linked_step(
     C = jnp.where(is_acting_leader, lead_commit, C)
     C = jnp.where(sync_b, jnp.maximum(C, lead_commit), C)
 
+    if transferee is not None:
+        # reset-abort invariant (see step()): only standing leaders keep
+        # their lead_transferee.
+        transferee = jnp.where(St == ROLE_LEADER, transferee, 0)
     out = SimState(
         term=T,
         state=St,
@@ -1459,6 +2016,8 @@ def _linked_step(
         voter_mask=st.voter_mask,
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
+        recent_active=st.recent_active,
+        transferee=transferee,
     )
     if counters is None and health is None and reconfig_propose is None:
         return out
@@ -1467,14 +2026,33 @@ def _linked_step(
     if counters is not None:
         counters = kernels.count_events(
             counters, want_campaign, want_heartbeat, won_any,
-            out.commit - st.commit,
+            out.commit - st_in.commit,
         )
+        if t_extra is not None:
+            counters = counters.at[kernels.CTR_CAMPAIGNS].add(
+                jnp.sum(t_extra[0], dtype=jnp.int32)
+            )
+            counters = counters.at[kernels.CTR_ELECTIONS_WON].add(
+                jnp.sum(t_extra[1], dtype=jnp.int32)
+            )
         extras = extras + (counters,)
     if health is not None:
         has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
-        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
-        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(
+            st_in.commit, axis=0
+        )
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st_in.term, axis=0)
         campaigned = jnp.any(want_campaign, axis=0)
+        if t_extra is None:
+            won_h = won_any
+        else:
+            # Observed end-of-round `won` when a transfer phase ran (the
+            # oracle's rule; see the damped path).
+            won_h = jnp.any(
+                (out.state == ROLE_LEADER)
+                & ((st_in.state != ROLE_LEADER) | (out.term > st_in.term)),
+                axis=0,
+            )
         planes, pos = kernels.update_health(
             health.planes,
             health.window_pos,
@@ -1482,7 +2060,7 @@ def _linked_step(
             has_lead_end,
             commit_adv,
             term_bump,
-            campaigned & ~won_any,
+            campaigned & ~won_h,
         )
         extras = extras + (HealthState(planes, pos),)
     if reconfig_propose is not None:
@@ -1491,6 +2069,10 @@ def _linked_step(
         # the round's workload); owner 0 where no alive leader acted, so
         # the pending op retries next round.
         prop_mask = has_leader & reconfig_propose
+        if blocked is not None:
+            # A pending transfer drops the conf entry with the rest of
+            # the batch (ProposalDropped); owner 0 makes the op retry.
+            prop_mask = prop_mask & ~blocked
         extras = extras + (
             ReconfigProposal(
                 owner=jnp.where(prop_mask, first_l + 1, 0),
@@ -1511,6 +2093,8 @@ def _damped_linked_step(
     counters: Optional[jnp.ndarray] = None,  # gc: int32[N]
     health: Optional[HealthState] = None,  # gc: HealthState
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
+    transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
+    campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
 ) -> Union[SimState, Tuple]:
     """The damped (check-quorum / pre-vote / lease) pairwise round.
 
@@ -1553,6 +2137,15 @@ def _damped_linked_step(
             "or carry the plane over explicitly"
         )
     G, P = cfg.n_groups, cfg.n_peers
+    st_in = st
+    t_extra = None
+    if st.transferee is not None:
+        # The transfer pre-tick pump, link-gated and lease-exempt (the
+        # CAMPAIGN_TRANSFER force context; see _transfer_phase).
+        st, t_campaigned, t_won = _transfer_phase(
+            cfg, st, crashed, transfer_propose, link, group_ids
+        )
+        t_extra = (t_campaigned, t_won)
     cq = cfg.check_quorum
     pv = cfg.pre_vote
     et = cfg.election_tick
@@ -1604,6 +2197,23 @@ def _damped_linked_step(
         want_heartbeat = want_heartbeat & ~cq_dep
     else:
         cq_dep = jnp.zeros((P, G), bool)
+
+    if campaign_kick is not None:
+        # Autopilot campaign kick (MsgHup at tick time; see step()) — a
+        # kicked peer campaigns through the ordinary damped machinery
+        # (pre-vote probe first when cfg.pre_vote, like hup(false)).
+        kicked = campaign_kick & (st.state != ROLE_LEADER) & promotable
+        want_campaign = want_campaign | kicked
+        if not pv:
+            # become_candidate's reset zeroes the election clock; a
+            # pre-vote kick keeps it (become_pre_candidate touches only
+            # role/leader_id, and the kick is a MsgHup, not a timer fire).
+            ee = jnp.where(kicked, 0, ee)
+    transferee = st.transferee
+    if transferee is not None:
+        # Tick-time transfer abort (reference: raft.rs:1051-1079): the
+        # boundary fires with or without the check-quorum deposal.
+        transferee = jnp.where(want_cq, 0, transferee)
 
     # ---- campaign local effects.  Real: become_candidate (term+1, vote
     # self, redraw).  Pre-vote: become_pre_candidate touches ONLY the role
@@ -2392,6 +3002,13 @@ def _damped_linked_step(
     first_l = jnp.min(jnp.where(is_acting, p_idx, P), axis=0)
     is_acting_leader = (p_idx == first_l) & has_leader
     n_app = jnp.where(has_leader, append_n, 0)
+    if transferee is not None:
+        # ProposalDropped while a transfer is pending at the acting
+        # leader (reference: raft.rs step_leader's lead_transferee gate).
+        blocked = jnp.any(is_acting_leader & (transferee > 0), axis=0)
+        n_app = jnp.where(blocked, 0, n_app)
+    else:
+        blocked = None
     sent_b = has_leader & (n_app > 0)
     lead_pre_last = jnp.max(jnp.where(is_acting_leader, LI, 0), axis=0)
     LI = LI + jnp.where(is_acting_leader, n_app, 0)
@@ -2475,6 +3092,10 @@ def _damped_linked_step(
     HB = jnp.where(dw, 0, HB)
     RT = jnp.where(dw, draw(T), RT)
 
+    if transferee is not None:
+        # reset-abort invariant (see step()): only standing leaders keep
+        # their lead_transferee.
+        transferee = jnp.where(St == ROLE_LEADER, transferee, 0)
     out = SimState(
         term=T,
         state=St,
@@ -2493,6 +3114,7 @@ def _damped_linked_step(
         outgoing_mask=st.outgoing_mask,
         learner_mask=st.learner_mask,
         recent_active=RA,
+        transferee=transferee,
     )
     if counters is None and health is None and reconfig_propose is None:
         return out
@@ -2504,11 +3126,18 @@ def _damped_linked_step(
         # hb_send).
         counters = kernels.count_events(
             counters, want_campaign, hb_send, jnp.any(won, axis=0),
-            out.commit - st.commit,
+            out.commit - st_in.commit,
         )
         if pv:
             counters = counters.at[kernels.CTR_CAMPAIGNS].add(
                 jnp.sum(real_req, dtype=jnp.int32)
+            )
+        if t_extra is not None:
+            counters = counters.at[kernels.CTR_CAMPAIGNS].add(
+                jnp.sum(t_extra[0], dtype=jnp.int32)
+            )
+            counters = counters.at[kernels.CTR_ELECTIONS_WON].add(
+                jnp.sum(t_extra[1], dtype=jnp.int32)
             )
         extras = extras + (counters,)
     if health is not None:
@@ -2517,12 +3146,14 @@ def _damped_linked_step(
         # a non-Leader pre-round role — a transient winner deposed later
         # in the same round does NOT count.  Mirror that here.
         has_lead_end = jnp.any((out.state == ROLE_LEADER) & alive, axis=0)
-        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(st.commit, axis=0)
-        term_bump = jnp.max(out.term, axis=0) - jnp.max(st.term, axis=0)
+        commit_adv = jnp.max(out.commit, axis=0) > jnp.max(
+            st_in.commit, axis=0
+        )
+        term_bump = jnp.max(out.term, axis=0) - jnp.max(st_in.term, axis=0)
         campaigned = jnp.any(want_campaign, axis=0)
         won_end = jnp.any(
             (out.state == ROLE_LEADER)
-            & ((st.state != ROLE_LEADER) | (out.term > st.term)),
+            & ((st_in.state != ROLE_LEADER) | (out.term > st_in.term)),
             axis=0,
         )
         planes, pos = kernels.update_health(
@@ -2543,6 +3174,10 @@ def _damped_linked_step(
         # processing its deposing ack.  The reconfig runner's gate then
         # sees the deposed owner and retries the op.
         prop_mask = has_leader & reconfig_propose
+        if blocked is not None:
+            # A pending transfer drops the conf entry with the rest of
+            # the batch (ProposalDropped); owner 0 makes the op retry.
+            prop_mask = prop_mask & ~blocked
         extras = extras + (
             ReconfigProposal(
                 owner=jnp.where(prop_mask, first_l + 1, 0),
@@ -3315,9 +3950,11 @@ class ClusterSim:
                 st.commit[:, group_id],
                 st.last_index[:, group_id],
                 st.leader_id[:, group_id],
+                st.voter_mask[:, group_id] | st.outgoing_mask[:, group_id],
+                st.learner_mask[:, group_id],
             )
         )
-        term, role, commit, last_index, leader_id = cols
+        term, role, commit, last_index, leader_id, voter, learner = cols
         return {
             "group": int(group_id),
             "health": dict(
@@ -3329,6 +3966,11 @@ class ClusterSim:
                 "commit": [int(v) for v in commit],
                 "last_index": [int(v) for v in last_index],
                 "leader_id": [int(v) for v in leader_id],
+                # Config membership: the autopilot's target filter (a
+                # learner or removed peer is never a kick/transfer
+                # target).
+                "voter": [bool(v) for v in voter],
+                "learner": [bool(v) for v in learner],
             },
         }
 

@@ -105,7 +105,8 @@ class ScalarCluster:
     def round(self, crashed: Optional[np.ndarray] = None,
               append_n: Optional[np.ndarray] = None,
               link: Optional[np.ndarray] = None,
-              conf_propose: Optional[np.ndarray] = None):
+              conf_propose: Optional[np.ndarray] = None,
+              kick: Optional[np.ndarray] = None):
         """One lockstep protocol round across all groups.
 
         crashed:  bool[G, P] whole-peer isolation for the round.
@@ -122,6 +123,13 @@ class ScalarCluster:
                   leader's term at propose time, or (0, 0, 0) where no
                   alive leader acted — mirroring sim.ReconfigProposal
                   bit-for-bit.  Returns None when conf_propose is None.
+        kick:     optional bool[G, P] — the autopilot campaign kick (the
+                  scalar twin of sim.step's campaign_kick): a MsgHup
+                  stepped at the peer right after its tick, i.e. the
+                  RawNode::campaign admin call.  A kick lands only when
+                  the peer's own election timer did NOT fire this tick
+                  (the device ORs the two into one campaign), and MsgHup
+                  itself enforces the leader/promotable gates (hup()).
         """
         if crashed is None:
             crashed = np.zeros((self.n_groups, self.n_peers), dtype=bool)
@@ -141,7 +149,17 @@ class ScalarCluster:
             initial: List[Message] = []
             for p in range(1, self.n_peers + 1):
                 peer = net.peers[p]
+                fired = (
+                    peer.raft.state != StateRole.Leader
+                    and peer.raft.promotable
+                    and peer.raft.election_elapsed + 1
+                    >= peer.raft.randomized_election_timeout
+                )
                 peer.raft.tick()
+                if kick is not None and bool(kick[g][p - 1]) and not fired:
+                    peer.raft.step(
+                        Message(msg_type=MessageType.MsgHup, from_=p, to=p)
+                    )
                 peer.persist()
                 initial.extend(net.filter(peer.read_messages()))
             net.send(initial)
@@ -285,18 +303,28 @@ class HealthOracle:
                 commit[g, p] = r.raft_log.committed
         return state, term, commit, int(StateRole.Leader)
 
+    def _pre_round(self, crashed, link) -> None:
+        """Hook between the pre-round capture and the want_campaign read:
+        the TransferOracle's pre-tick transfer pump runs here (the device
+        twin, sim._transfer_phase, runs before the round's ticks, so the
+        tick-time campaign facts must be read AFTER it).  No-op here."""
+
     def round(self, crashed=None, append_n=None, link=None,
-              conf_propose=None):
+              conf_propose=None, kick=None):
         """Drive one cluster round and fold its health facts into the
         planes (the scalar twin of sim.step's health extra).  `link` is
-        the optional bool[P, P, G] chaos reachability plane and
-        `conf_propose` the optional bool[G] conf-entry propose mask, both
-        passed through to ScalarCluster.round; returns its proposal
-        records (None unless conf_propose is given)."""
+        the optional bool[P, P, G] chaos reachability plane,
+        `conf_propose` the optional bool[G] conf-entry propose mask, and
+        `kick` the optional bool[G, P] campaign-kick mask, all passed
+        through to ScalarCluster.round; returns its proposal records
+        (None unless conf_propose is given).  A kicked campaign joins the
+        `campaigned` health fact exactly like the device fold (the kick
+        IS a campaign() call)."""
         G, P = self.cluster.n_groups, self.cluster.n_peers
         if crashed is None:
             crashed = np.zeros((G, P), dtype=bool)
         pre_state, pre_term, pre_commit, leader_code = self._capture()
+        self._pre_round(crashed, link)
         want_campaign = np.zeros((G, P), dtype=bool)
         for g in range(G):
             for p in range(P):
@@ -304,10 +332,16 @@ class HealthOracle:
                 want_campaign[g, p] = (
                     int(r.state) != leader_code
                     and r.promotable
-                    and r.election_elapsed + 1 >= r.randomized_election_timeout
+                    and (
+                        r.election_elapsed + 1
+                        >= r.randomized_election_timeout
+                        or (kick is not None and bool(kick[g][p]))
+                    )
                 )
 
-        props = self.cluster.round(crashed, append_n, link, conf_propose)
+        props = self.cluster.round(
+            crashed, append_n, link, conf_propose, kick=kick
+        )
 
         post_state, post_term, post_commit, _ = self._capture()
         alive = ~np.asarray(crashed, dtype=bool)
@@ -371,6 +405,107 @@ class ChaosOracle(HealthOracle):
         # Schedule planes are peer-major [P, G]; the scalar round wants
         # [G, P] crash rows.
         self.round(crashed=crashed.T, append_n=append, link=link)
+
+
+class TransferOracle(HealthOracle):
+    """Scalar-side oracle for the batched leader-transfer protocol
+    (ISSUE 12): drives the REAL RawNode::transfer_leader machinery —
+    handle_transfer_leader's validation/abort rules, the catch-up append,
+    MsgTimeoutNow, hup(true)'s CAMPAIGN_TRANSFER forced election, the
+    ProposalDropped gate, and the tick-time election-timeout abort —
+    through the harness pump, one drain-cadence round at a time, exactly
+    as sim._transfer_phase models it:
+
+      * a round's `transfer_propose[g]` (1-based target, 0 = none) steps
+        MsgTransferLeader at the group's acting leader BEFORE the ticks
+        and pumps it to quiescence — a reachable transfer completes
+        within the round (catch-up, TimeoutNow, forced election, noop
+        commit), an unreachable one leaves lead_transferee pending;
+      * a PENDING transfer is nudged each round with an empty catch-up
+        append (`_maybe_send_append(allow_empty=True)` — the effect the
+        heartbeat-response chain has in the full-message system), whose
+        ack re-triggers the TimeoutNow check;
+      * `kick[g][p]` steps MsgHup at tick time (the RawNode::campaign
+        admin call — the autopilot's re-election kick).
+
+    tests/test_transfer_batched.py asserts exact per-round equality of
+    every peer's state AND the health planes against ClusterSim stepping
+    identical schedules through the transfer-enabled device paths
+    (plain, linked, and damped).
+
+    This class is the resolved GC010 oracle symbol for the transfer
+    kernels (tools/graftcheck/parity_obligations.json: apply_transfer ->
+    simref.TransferOracle); renaming it or its entry points is an
+    obligation change and must go through `make obligations`.
+    """
+
+    def __init__(self, cluster: ScalarCluster, window: int = 32):
+        super().__init__(cluster, window=window)
+        self._transfer_propose = None
+
+    def round(self, crashed=None, append_n=None, link=None,
+              conf_propose=None, kick=None, transfer_propose=None):
+        """One round with optional transfer commands: the pre-tick pump
+        runs in the `_pre_round` hook (after the health capture, before
+        the want_campaign read — where the device phase sits)."""
+        self._transfer_propose = transfer_propose
+        return super().round(
+            crashed, append_n, link, conf_propose, kick=kick
+        )
+
+    def pending(self) -> np.ndarray:
+        """int64[G, P] lead_transferee per peer (0 = none) — the scalar
+        twin of SimState.transferee for parity comparison."""
+        G, P = self.cluster.n_groups, self.cluster.n_peers
+        out = np.zeros((G, P), dtype=np.int64)
+        for g in range(G):
+            for p in range(P):
+                r = self.cluster.networks[g].peers[p + 1].raft
+                out[g, p] = r.lead_transferee or 0
+        return out
+
+    def _pre_round(self, crashed, link) -> None:
+        tp = self._transfer_propose
+        self._transfer_propose = None
+        cl = self.cluster
+        for g, net in enumerate(cl.networks):
+            # The round's faults gate the pump (the parent round
+            # re-installs the same masks afterwards — idempotent).
+            cl._apply_crash_mask(
+                net, crashed[g], None if link is None else link[:, :, g]
+            )
+            lead = cl.acting_leader(g, crashed[g])
+            if lead is None:
+                continue
+            r = net.peers[lead].raft
+            want = 0 if tp is None else int(tp[g])
+            if want and want != (r.lead_transferee or 0):
+                # The admin command reaches the leader out-of-band (the
+                # autopilot talks to it directly), so it is stepped, not
+                # routed through the faulted network.  The drain-cadence
+                # pump probes unconditionally (the device phase has no
+                # pause state), so a paused probe is resumed first.
+                pr = r.prs.get_mut(want)
+                if pr is not None:
+                    pr.paused = False
+                r.step(
+                    Message(
+                        msg_type=MessageType.MsgTransferLeader,
+                        from_=want,
+                        to=lead,
+                    )
+                )
+            elif r.lead_transferee is not None:
+                pr = r.prs.get_mut(r.lead_transferee)
+                if pr is not None:
+                    pr.paused = False
+                    r._maybe_send_append(
+                        r.lead_transferee, pr, allow_empty=True
+                    )
+            else:
+                continue
+            net.peers[lead].persist()
+            net.send(net.filter(net.peers[lead].read_messages()))
 
 
 class ReconfigOracle(HealthOracle):

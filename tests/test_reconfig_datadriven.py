@@ -120,7 +120,7 @@ class ReconfigHarness:
             )
             op_ptr = np.asarray(rst.op_ptr)
             (
-                state3, leader3, commit3, matched3, vm3, om3, lm3, _,
+                state3, leader3, commit3, matched3, vm3, om3, lm3, _, _,
             ) = kernels.apply_confchange(
                 st2.state, st2.leader_id, st2.commit,
                 st2.term_start_index, st2.matched, st2.voter_mask,

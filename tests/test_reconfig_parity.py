@@ -92,7 +92,7 @@ def make_round_fn(cfg, compiled, ccompiled):
             crashed=crashed, prev_voter_mask=rst.prev_voter,
             prev_outgoing_mask=rst.prev_outgoing,
         )
-        (state3, leader3, commit3, matched3, vm3, om3, lm3, ra3) = (
+        (state3, leader3, commit3, matched3, vm3, om3, lm3, ra3, tr3) = (
             kernels.apply_confchange(
                 st2.state, st2.leader_id, st2.commit,
                 st2.term_start_index, st2.matched, st2.voter_mask,
@@ -497,7 +497,7 @@ def test_apply_confchange_reactions():
     tgt_v = jnp.asarray([[False] * g, [True] * g, [False] * g])
     tgt_o = jnp.asarray([[True] * g, [True] * g, [False] * g])
     no = jnp.zeros((3, g), bool)
-    st2, ld2, c2, m2, vm2, om2, lm2, ra2 = kernels.apply_confchange(
+    st2, ld2, c2, m2, vm2, om2, lm2, ra2, _ = kernels.apply_confchange(
         state, leader_id, commit, ts, matched, vm, om, lm,
         tgt_v, tgt_o, no, no, no, apply_mask, ra,
     )
@@ -511,7 +511,7 @@ def test_apply_confchange_reactions():
     assert np.asarray(c2)[0, 0] == 7 and np.asarray(c2)[0, 2] == 5
 
     # joint-exit that drops the leader entirely: incoming {2}, outgoing {}
-    st3, ld3, c3, m3, vm3, om3, lm3, ra3 = kernels.apply_confchange(
+    st3, ld3, c3, m3, vm3, om3, lm3, ra3, _ = kernels.apply_confchange(
         state, leader_id, commit, ts, matched, tgt_v, tgt_o, lm,
         tgt_v, no, no, no,
         jnp.asarray([[True] * g, [False] * g, [False] * g]),  # removed: 1
@@ -525,7 +525,7 @@ def test_apply_confchange_reactions():
 
     # add a fresh member 3: rows zeroed, recent_active granted
     tgt_v3 = jnp.asarray([[True] * g, [True] * g, [True] * g])
-    st4, ld4, c4, m4, vm4, om4, lm4, ra4 = kernels.apply_confchange(
+    st4, ld4, c4, m4, vm4, om4, lm4, ra4, _ = kernels.apply_confchange(
         state, leader_id, commit, ts, matched, vm, om, lm,
         tgt_v3, no, no,
         jnp.asarray([[False] * g, [False] * g, [True] * g]),  # added: 3

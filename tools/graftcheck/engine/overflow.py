@@ -120,6 +120,25 @@ DAMPING_PLANES: Dict[str, tuple] = {
     ),
 }
 
+# Transfer planes (ISSUE 12): device state added by the leader-transfer
+# protocol (SimConfig.transfer), registered like the damping planes so a
+# dtype/bound change goes through this registry.  transferee is the
+# per-owner lead_transferee peer id: values are validated into
+# [0, n_peers] by kernels.apply_transfer (the reference's
+# progress-map/learner/self checks) and only ever SET from the
+# `transfer_propose` command or cleared to 0 — never arithmetic, so with
+# n_peers <= 8 (the TPU peer-axis bound) it fits 4 bits and has no
+# overflow surface; it stays int32 for the native [P, G] plane layout.
+# Enforced by check_sim below exactly like DAMPING_PLANES: every key
+# must BE a SimState field.
+TRANSFER_PLANES: Dict[str, tuple] = {
+    "transferee": (
+        4,
+        "peer id in [0, n_peers]; set from validated commands "
+        "(kernels.apply_transfer) or cleared, never arithmetic",
+    ),
+}
+
 
 def _v(sf: SourceFile, lineno: int, message: str) -> Violation:
     return Violation(sf.display_path, lineno, GC008, GC008_SLUG, message)
@@ -366,6 +385,15 @@ def check_sim(sf: SourceFile) -> Iterator[Violation]:
                     sf,
                     sim_state.lineno,
                     f"DAMPING_PLANES registers {name!r} but SimState has "
+                    "no such field; the registered bound is orphaned — "
+                    "rename the registry entry with the field",
+                )
+        for name, (bits, _why) in TRANSFER_PLANES.items():
+            if name not in fields:
+                yield _v(
+                    sf,
+                    sim_state.lineno,
+                    f"TRANSFER_PLANES registers {name!r} but SimState has "
                     "no such field; the registered bound is orphaned — "
                     "rename the registry entry with the field",
                 )

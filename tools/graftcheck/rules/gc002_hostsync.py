@@ -31,6 +31,10 @@ _KERNEL_MODULES = (
     "raft_tpu/multiraft/kernels.py",
     "raft_tpu/multiraft/pallas_step.py",
     "raft_tpu/multiraft/health.py",
+    # The autopilot's cadence loop sits on the drain boundary like the
+    # HealthMonitor: its only legitimate syncs are the cadence-boundary
+    # summary/policy reads, each carrying an allow-marker.
+    "raft_tpu/multiraft/autopilot.py",
 )
 
 _NUMPY_ALIASES = {"np", "numpy", "onp", "_np"}

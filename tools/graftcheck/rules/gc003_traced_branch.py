@@ -50,6 +50,7 @@ _STATIC_CONFIG_FIELDS = {
     "health_topk",
     "check_quorum",
     "pre_vote",
+    "transfer",
     "min_timeout",
     "max_timeout",
 }

@@ -41,6 +41,7 @@ _METRICS_MODULES = (
     "raft_tpu/raw_node.py",
     "raft_tpu/multiraft/driver.py",
     "raft_tpu/multiraft/health.py",
+    "raft_tpu/multiraft/autopilot.py",
 )
 
 

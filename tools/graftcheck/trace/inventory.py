@@ -340,6 +340,83 @@ def _split_runner_builder():
     return build
 
 
+def _transfer_step_builder():
+    def build() -> Built:
+        import functools
+
+        import jax
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, transfer=True
+        )
+        st, crashed, append_n = _base_args(cfg)
+        fn = jax.jit(functools.partial(sim.step, cfg))
+        import jax.numpy as jnp
+
+        # Positional tail: (group_ids, counters, health, link,
+        # reconfig_propose, transfer_propose, campaign_kick) — the
+        # transfer-enabled production round with both action planes live.
+        args = (
+            st, crashed, append_n, None, None, sim.init_health(cfg),
+            None, None,
+            jnp.zeros((G,), jnp.int32),
+            jnp.zeros((P, G), bool),
+        )
+        return Built(fn, args)
+
+    return build
+
+
+def _autopilot_runner_builder():
+    def build() -> Built:
+        import jax.numpy as jnp
+
+        from raft_tpu.multiraft import autopilot, chaos, kernels, reconfig
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, transfer=True
+        )
+        cplan = chaos.ChaosPlan(
+            name="graftcheck-inventory",
+            n_peers=P,
+            phases=[
+                chaos.ChaosPhase(
+                    rounds=SCAN_ROUNDS * 2, partition=[[1], [2, 3]],
+                    append=1,
+                ),
+            ],
+        )
+        chaos_compiled = chaos.compile_plan(cplan, G)
+        compiled = autopilot.empty_reconfig_schedule(
+            SCAN_ROUNDS * 2, P, G
+        )
+        runner = autopilot.make_cadence_runner(
+            cfg, compiled, chaos_compiled, SCAN_ROUNDS
+        )
+        st, _, _ = _base_args(cfg)
+        args = (
+            st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
+            jnp.zeros((chaos.N_CHAOS_STATS,), jnp.int32),
+            jnp.zeros((reconfig.N_RECONFIG_STATS,), jnp.int32),
+            jnp.zeros((kernels.N_SAFETY,), jnp.int32),
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.zeros((G,), jnp.int32),
+            jnp.zeros((P, G), bool),
+            compiled.phase_of_round, compiled.append, compiled.op_start,
+            compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
+            compiled.tgt_learner, compiled.added, compiled.removed,
+            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
+            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
+            chaos_compiled.append,
+        )
+        return Built(runner, args, (0, 1, 2, 3, 4, 5, 6))
+
+    return build
+
+
 def _sharded_builder(kind: str):
     def build() -> Built:
         import jax
@@ -424,6 +501,28 @@ def _specs() -> List[GraphSpec]:
             build=_run_compiled_builder(
                 {}, {"check_quorum": True, "pre_vote": True}
             ),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The transfer-enabled round (ISSUE 12): the pre-tick
+            # transfer pump + both autopilot action planes live; the
+            # transfer-OFF graphs are the bit-identical step@* rows
+            # above (the pinned-unchanged claim).
+            name="step@health+transfer",
+            anchor=sim_py,
+            build=_transfer_step_builder(),
+            audit_donation=False,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The autopilot's cadence segment (ISSUE 12): chaos masks +
+            # the reconfig op protocol + action planes + the
+            # commit-stall fold in one donated scan.
+            name="autopilot_cadence@health+chaos+transfer",
+            anchor="raft_tpu/multiraft/autopilot.py",
+            build=_autopilot_runner_builder(),
         )
     )
     out.append(
