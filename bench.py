@@ -135,6 +135,23 @@ Production split-fused mode (ISSUE 11) replaces the steady bench:
   --split-k N     fused block length (default 8).
   --split-window N  general rounds planned around each op (default 4).
 
+Serving-workload mode (ISSUE 13; docs/OBSERVABILITY.md "Reads")
+replaces the steady bench:
+
+  --reads F       run the client read/write plan F (JSON,
+                  raft_tpu.multiraft.workload — a bare ClientPlan
+                  document, or {"client": ..., "chaos": ...} to overlay
+                  an equal-length fault schedule) through the production
+                  damped configuration (check_quorum + pre_vote +
+                  lease_read).  Bare plans ride the split-fused runner
+                  (pure-lease stretches fused, measured fused_frac); the
+                  JSON line carries the read counters and the on-device
+                  p50/p90/p99 read latency under the
+                  `raft_read_ticks_per_sec` metric key, and any nonzero
+                  safety count — the stale-read/dual-lease
+                  linearizability slots included — exits 2.
+  --reads-out F   also write the read report JSON to F (CI artifact).
+
 Baseline entries carrying `"retired": true` (e.g. the pre-fusion
 wave-replay `_cq` series) are historical anchors: --check skips them
 with a `retired-baseline` notice instead of gating on them, and
@@ -813,6 +830,120 @@ def bench_autopilot(
     }
 
 
+def bench_reads(
+    plan_path: str,
+    groups: int,
+    reps: int,
+    reads_out: str = "",
+    k: int = 8,
+) -> dict:
+    """The serving workload (ISSUE 13): a compiled client read/write plan
+    (raft_tpu.multiraft.workload — Zipf write skew, per-phase Safe/Lease
+    read mixes) driven through the production damped configuration
+    (check_quorum + pre_vote + lease_read, election_tick=64 — the fused
+    regime) with the full per-round safety audit INCLUDING the
+    linearizability slots.  A bare plan runs the split-fused runner
+    (workload.make_split_runner): pure-lease stretches ride the fused
+    Pallas kernel with their receipts folded closed-form, quorum-round
+    reads fall back honestly — the JSON line's `fused_frac` is the
+    measured coverage.  A {"client": ..., "chaos": ...} document overlays
+    an equal-length fault schedule through the general scan (reads during
+    partitions; fused_frac honestly 0).
+
+    The report carries the read latency percentiles (p50/p90/p99 in
+    protocol rounds, reduced ON DEVICE by workload.latency_percentiles —
+    the profiling.py nearest-rank rule) and the read/serve/degrade
+    counters; any nonzero safety count exits 2.  Leaders settle outside
+    the timed region (3x election_tick), each rep replaying the plan from
+    a copy of the settled state (the runner donates its carry)."""
+    from raft_tpu.multiraft import chaos, reconfig, sim, workload
+    from raft_tpu.multiraft.sim import SimConfig
+
+    with open(plan_path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    chaos_doc = doc.get("chaos")
+    plan = workload.plan_from_dict(doc.get("client", doc))
+    cfg = SimConfig(
+        n_groups=groups, n_peers=plan.n_peers, election_tick=64,
+        collect_health=True, check_quorum=True, pre_vote=True,
+        lease_read=True,
+    )
+    compiled = workload.compile_plan(plan, groups)
+    interpret = jax.default_backend() == "cpu"
+    if chaos_doc is None:
+        runner = workload.make_split_runner(
+            cfg, compiled, k=k, interpret=interpret
+        )
+    else:
+        chaos_compiled = chaos.compile_plan(
+            chaos.plan_from_dict(chaos_doc), groups
+        )
+        runner = workload.make_runner(cfg, compiled, chaos_compiled)
+    step = jax.jit(functools.partial(sim.step, cfg))
+    crashed0 = jnp.zeros((plan.n_peers, groups), bool)
+    settle_append = jnp.ones((groups,), jnp.int32)
+    st0 = sim.init_state(cfg)
+    for _ in range(3 * cfg.election_tick):
+        st0 = step(st0, crashed0, settle_append)
+    jax.block_until_ready(st0)
+
+    def fresh():
+        st = jax.tree.map(jnp.copy, st0)
+        return (
+            st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
+            workload.init_read_carry(groups),
+        )
+
+    out = runner(*fresh())  # compile + first run
+    jax.block_until_ready(out[3])
+    samples = []
+    fused_total = 0
+    for _ in range(reps):
+        args = fresh()
+        jax.block_until_ready(args)
+        t0 = time.perf_counter()
+        out = runner(*args)
+        jax.block_until_ready(out[3])
+        samples.append(
+            groups * plan.n_rounds / (time.perf_counter() - t0)
+        )
+        if chaos_doc is None:
+            fused_total += int(jax.device_get(out[9]))
+    _st, _hl, _rst, stats, _rstats, safety, _rcar, rdstats, lat_hist = (
+        out[:9]
+    )
+    lat_p = workload.latency_percentiles(lat_hist)
+    rdstats_h, lat_p_h, safety_h, stats_h = jax.device_get(
+        (rdstats, lat_p, safety, stats)
+    )
+    report = workload.read_report(
+        rdstats_h, lat_p_h, safety_h, stats_h, plan.n_rounds
+    )
+    report["plan"] = plan.name
+    report["groups"] = groups
+    report["peers"] = plan.n_peers
+    report["phases"] = len(plan.phases)
+    report["chaos_overlay"] = chaos_doc is not None
+    if reads_out:
+        with open(reads_out, "w") as f:
+            json.dump(report, f)
+    if any(report["safety"].values()):
+        print(
+            f"ERROR: read plan {plan.name} violated safety invariants "
+            f"(linearizability slots included): {report['safety']}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    return {
+        "report": report,
+        "read_p50": report["read_p50"],
+        "read_p90": report["read_p90"],
+        "read_p99": report["read_p99"],
+        **rep_stats(samples),
+        **fused_fields(fused_total, groups * plan.n_rounds * reps),
+    }
+
+
 def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
@@ -977,6 +1108,8 @@ def main() -> None:
     ap.add_argument("--autopilot", action="store_true")
     ap.add_argument("--autopilot-plan", default="", metavar="PLAN_JSON")
     ap.add_argument("--autopilot-out", default="", metavar="FILE")
+    ap.add_argument("--reads", default="", metavar="PLAN_JSON")
+    ap.add_argument("--reads-out", default="", metavar="FILE")
     ap.add_argument("--cadence", type=int, default=16)
     ap.add_argument("--split-k", type=int, default=8)
     ap.add_argument("--split-window", type=int, default=4)
@@ -1025,6 +1158,35 @@ def main() -> None:
         ap.error("--autopilot is its own mode (chaos via --autopilot-plan)")
     if (args.autopilot_plan or args.autopilot_out) and not args.autopilot:
         ap.error("--autopilot-plan/--autopilot-out require --autopilot")
+    if args.reads and (
+        args.chaos or args.reconfig or args.prod_fused or args.autopilot
+    ):
+        ap.error("--reads is its own mode (overlay chaos via the plan "
+                 "file's \"chaos\" key)")
+    if args.reads_out and not args.reads:
+        ap.error("--reads-out requires --reads")
+
+    if args.reads:
+        read_stats = bench_reads(
+            args.reads, args.groups, args.reps, args.reads_out,
+            k=args.split_k,
+        )
+        warn_spread("reads device", read_stats)
+        line = {
+            "metric": "raft_read_ticks_per_sec",
+            "value": read_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            "check_quorum": True,
+            "pre_vote": True,
+            "lease_read": True,
+            **read_stats,
+        }
+        print(json.dumps(line))
+        enforce_fused_floor(line)
+        if args.check:
+            run_check(args, line)
+        return
 
     if args.autopilot:
         ap_stats = bench_autopilot(

@@ -179,6 +179,7 @@ def bench_baseline_configs(results, quick):
         results.append(bench_config4_reconfig_compiled())
         results.append(bench_config4_joint_churn())
         results.append(bench_read_barrier())
+        results.append(bench_reads_workload())
         results.append(bench_fused_instrumented())
         results.append(bench_fused_damped())
         results.append(bench_prod_fused_split())
@@ -392,6 +393,33 @@ def bench_read_barrier():
     jax.block_until_ready(many(st, crashed))
     dt = time.perf_counter() - t0
     return ("read_index: 100k x 5 barrier", G * reads / dt / 1e6, "M reads/s")
+
+
+def bench_reads_workload(G=100_000):
+    """config3r: the SERVING workload (ISSUE 13) — the zipf_mixed client
+    plan (Zipf-skewed writes + Safe/Lease read mixes) through the
+    production damped configuration with the split-fused runner, the
+    linearizability safety net live every round.  Delegates to
+    bench.bench_reads so the regime (SimConfig, settle, split knobs) is
+    defined ONCE; the row label carries the measured fused fraction and
+    the device-reduced read p99 so the table can't hide a degraded read
+    path behind a throughput number."""
+    import os
+
+    import bench
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "reads", "zipf_mixed.json",
+    )
+    stats = bench.bench_reads(path, groups=G, reps=2)
+    return (
+        f"config3r: {G // 1000}k x {stats['report']['peers']} zipf "
+        f"read/write mix (fused_frac {stats['fused_frac']:.2f}, "
+        f"read_p99 {stats['read_p99']}r)",
+        stats["median"] / 1e6,
+        "M ticks/s",
+    )
 
 
 def bench_config4_reconfig_compiled():

@@ -37,6 +37,7 @@ from .sim import (
 from .simref import (
     ChaosOracle,
     HealthOracle,
+    ReadOracle,
     ReconfigOracle,
     ScalarCluster,
     TransferOracle,
@@ -44,6 +45,7 @@ from .simref import (
 
 __all__ = [
     "ChaosOracle",
+    "ReadOracle",
     "ReconfigOracle",
     "TransferOracle",
     "committed_index",
@@ -64,6 +66,7 @@ __all__ = [
     #   .chaos     fault-plan compiler + compiled-schedule runner
     #   .reconfig  membership-churn plan compiler + compiled-schedule runner
     #   .autopilot closed-loop control plane (kick/transfer/evacuate)
+    #   .workload  client read/write plan compiler + compiled-schedule runner
     #   .driver    MultiRaft host driver
     #   .native    NativeMultiRaft C++ engine bindings
     #   .pallas_step  fused steady-round kernels

@@ -136,6 +136,80 @@ def load_reconfig_state(path: str):
     return ReconfigState(**fields)
 
 
+_READ_FORMAT_VERSION = 1
+
+# The persisted read-protocol planes, in save order: the outstanding-read
+# carry (workload.ReadCarry) plus the run's accumulators, so a resumed
+# client workload reproduces its latency percentiles and serve counts
+# bit-identically.
+_READ_FIELDS = ("pending_mode", "pending_since", "read_stats", "lat_hist")
+
+
+def save_read_state(rcar, read_stats, lat_hist, path: str) -> None:
+    """Atomically write the client-read protocol carry (ISSUE 13):
+    workload.ReadCarry's outstanding-read planes plus the
+    [workload.N_READ_STATS] stats vector and the [workload.N_LAT_BUCKETS]
+    latency histogram — everything a mid-plan resume needs for
+    bit-identical read accounting (the schedule arrays recompile from the
+    plan, like the reconfig carry)."""
+    arrays = {
+        "pending_mode": np.asarray(rcar.pending_mode),
+        "pending_since": np.asarray(rcar.pending_since),
+        "read_stats": np.asarray(read_stats),
+        "lat_hist": np.asarray(lat_hist),
+        "__read_version__": np.asarray(_READ_FORMAT_VERSION),
+    }
+    dir_ = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_read_state(path: str):
+    """Load a read-protocol carry written by save_read_state; returns
+    (workload.ReadCarry, read_stats, lat_hist).  Loud ValueError on a
+    missing version marker (not a read checkpoint), an unsupported
+    version, or a missing plane (corrupt/truncated file)."""
+    from .workload import ReadCarry
+
+    with np.load(path) as data:
+        if "__read_version__" not in data:
+            raise ValueError(
+                f"{path!r} is not a read-state checkpoint (missing "
+                "version marker — did you pass a SimState checkpoint?)"
+            )
+        version = int(data["__read_version__"])
+        if version != _READ_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported read-state checkpoint version {version}"
+            )
+        fields = {}
+        for name in _READ_FIELDS:
+            if name not in data:
+                raise ValueError(
+                    f"read-state checkpoint {path!r} is missing plane "
+                    f"{name!r} (corrupt or truncated file)"
+                )
+            arr = data[name]
+            fields[name] = jnp.asarray(arr, dtype=arr.dtype)
+    return (
+        ReadCarry(
+            pending_mode=fields["pending_mode"],
+            pending_since=fields["pending_since"],
+        ),
+        fields["read_stats"],
+        fields["lat_hist"],
+    )
+
+
 def hard_states(state: SimState) -> Dict[str, np.ndarray]:
     """The durable per-peer raft state {term, vote, commit} (reference:
     proto/proto/eraftpb.proto:94-98), shaped [P, G]."""

@@ -288,6 +288,31 @@ class HealthMonitor:
                 m.trace("autopilot.safety", **report["safety"])
         return entry
 
+    def record_reads(self, report: dict) -> dict:
+        """Fold a client-read workload report (workload.read_report's
+        shape) into the flight recorder and trace stream; a nonzero
+        linearizability (or any safety) count raises a `reads.safety`
+        event so a stale-read can never scroll by silently."""
+        with self._lock:
+            entry = {"seq": self._seq, "ts": time.time(), "reads": report}
+            self._seq += 1
+            self._ring.append(entry)
+        m = self.metrics
+        if m is not None:
+            m.trace(
+                "reads.scenario",
+                rounds=report.get("rounds", 0),
+                reads_issued=report.get("reads_issued", 0),
+                served_lease=report.get("served_lease", 0),
+                served_quorum=report.get("served_quorum", 0),
+                degraded_serves=report.get("degraded_serves", 0),
+                read_p50=report.get("read_p50", -1),
+                read_p99=report.get("read_p99", -1),
+            )
+            if any(report.get("safety", {}).values()):
+                m.trace("reads.safety", **report["safety"])
+        return entry
+
     def record_scenario(self, report: dict) -> dict:
         """Fold a chaos scenario report (chaos_report's shape) into the
         flight recorder and trace stream; safety violations raise a

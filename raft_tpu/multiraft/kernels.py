@@ -76,7 +76,19 @@ here is missing from it or untested under tests/.
                                state it must never flag; the joint-window
                                slots run every reconfig round against
                                simref.ReconfigOracle state in
-                               tests/test_reconfig_parity.py)
+                               tests/test_reconfig_parity.py; the
+                               linearizability slots run every workload
+                               round against simref.ReadOracle state in
+                               tests/test_read_lease.py)
+  lease_read               <-> the LeaseBased serve decision of
+                               Raft.step_leader's MsgReadIndex arm under
+                               the check-quorum lease (reference:
+                               read_only.rs LeaseBased +
+                               raft.rs:2067-2096); simref.ReadOracle
+                               applies the identical host-side gate and
+                               drives the REAL scalar
+                               ReadOnlyOption::LeaseBased pump —
+                               tests/test_read_lease.py
   apply_confchange         <-> confchange.Changer transitions + raft.rs
                                post_conf_change reactions
                                (reference: changer.rs:40-280,
@@ -442,7 +454,11 @@ SV_CURSOR_INVALID = 3  # agree/commit cursors exceed log bounds
 SV_LEADER_NOT_IN_CONFIG = 4  # a non-follower outside voter|outgoing
 SV_COMMIT_NO_QUORUM = 5  # a commit advance lacking either joint majority
 SV_CONF_DOUBLE_CHANGE = 6  # an illegal single-step membership transition
-N_SAFETY = 7
+# Linearizability slots (ISSUE 13): checked only when the optional
+# lease-read args are given (same uniform-shape rule as the joint slots).
+SV_STALE_READ = 7  # a lease-served read older than a fleet-committed index
+SV_DUAL_LEASE = 8  # two peers hold a live read lease for one group at once
+N_SAFETY = 9
 
 SAFETY_NAMES = (
     "dual_leader",
@@ -452,7 +468,114 @@ SAFETY_NAMES = (
     "leader_not_in_config",
     "commit_no_quorum",
     "conf_double_change",
+    "stale_read",
+    "dual_lease",
 )
+
+
+def lease_read(
+    state: jnp.ndarray,  # gc: int32[P, G]
+    term: jnp.ndarray,  # gc: int32[P, G]
+    leader_id: jnp.ndarray,  # gc: int32[P, G]
+    election_elapsed: jnp.ndarray,  # gc: int32[P, G]
+    commit: jnp.ndarray,  # gc: int32[P, G]
+    term_start_index: jnp.ndarray,  # gc: int32[P, G]
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    election_tick: int,
+    check_quorum: bool,
+    transferee: Optional[jnp.ndarray] = None,  # gc: int32[P, G]
+    recent_active: Optional[jnp.ndarray] = None,  # gc: bool[P, P, G]
+    voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched LeaseBased read gate (reference: read_only.rs LeaseBased +
+    raft.rs step_leader MsgReadIndex 2067-2096): which peers could serve a
+    linearizable read LOCALLY — zero message rounds — under the
+    check-quorum leader lease, and what the group's acting leader would
+    answer.
+
+    A peer HOLDS a live read lease when every condition of the hardened
+    gate passes:
+
+      * `check_quorum` is on (static; the reference's Config.validate
+        rejects LeaseBased without it — without the boundary deposal the
+        "lease" is just hope) and the peer is an uncrashed leader whose
+        own `leader_id` names itself;
+      * its election-elapsed sits inside the lease window
+        (`election_elapsed < election_tick`): the check-quorum boundary
+        read-and-clears at election_tick, so a role-leader inside the
+        window is at most one interval past its last boundary.  (At
+        organic round boundaries the tick reset makes this implied for
+        alive leaders; it binds exactly in the clock-drift states the
+        stale-read trap injects — a paused clock is how raft-rs's own
+        docs say LeaseBased breaks.)
+      * its CURRENT recent_active row holds an active quorum
+        (check_quorum_active over the row accumulated SINCE the last
+        boundary clear): every ack in the current row is younger than
+        one election_tick, so a quorum of voters is still inside the
+        follower-lease window that makes them IGNORE vote requests —
+        by quorum intersection no higher-term leader can exist while
+        the gate passes.  This is deliberately STRONGER than raft-rs,
+        whose LeaseBased trusts the last boundary outcome: a boundary
+        can pass on acks up to a full interval old (the pre-partition
+        acks straddle the clear), stretching the effective lease to
+        2*election_tick while the cut-off majority elects after ~1 —
+        tests/test_read_lease.py's no-drift trap replay demonstrates
+        exactly that dual-lease window and pins this gate closing it;
+      * it has committed in its own term (`commit >= term_start_index` —
+        the commit_to_current_term gate that drops every MsgReadIndex in
+        the reference);
+      * no leader transfer is pending (`transferee == 0` when the plane
+        exists): MsgTimeoutNow forces a CAMPAIGN_TRANSFER election that
+        BYPASSES leases, so the lease is unsound while a transfer runs —
+        the reference serves anyway (a real raft-rs soundness gap); we
+        degrade to the ReadIndex quorum round instead, and
+        simref.ReadOracle applies the identical host-side gate before
+        choosing which scalar pump to drive.
+
+    Returns (holder bool[P, G], served bool[G], index int32[G]): the full
+    holder mask (the SV_DUAL_LEASE surface — at most one holder per group
+    on every reachable state), whether the group's ACTING leader (alive
+    max-term, lowest peer index — where the sim routes client reads) is a
+    holder, and the commit index it would serve (0 where not served; the
+    caller masks on `served`).  Pure — a probe, like read_index.
+    """
+    P = state.shape[0]
+    if not check_quorum:
+        # The static no-lease arm: shapes preserved, gate constant-false
+        # (the undamped configuration degrades every lease request).
+        G = state.shape[1]
+        return (
+            jnp.zeros((P, G), bool),
+            jnp.zeros((G,), bool),
+            jnp.zeros((G,), jnp.int32),
+        )
+    if recent_active is None or voter_mask is None or outgoing_mask is None:
+        raise ValueError(
+            "the check-quorum lease gate needs recent_active, voter_mask "
+            "and outgoing_mask (the ISSUE 7 damping planes)"
+        )
+    self_id = jnp.arange(P, dtype=jnp.int32)[:, None] + 1
+    holder = (
+        (state == ROLE_LEADER)
+        & ~crashed
+        & (leader_id == self_id)
+        & (election_elapsed < jnp.int32(election_tick))
+        & (commit >= term_start_index)
+        & check_quorum_active(recent_active, voter_mask, outgoing_mask)
+    )
+    if transferee is not None:
+        holder = holder & (transferee == 0)
+    # The acting leader — where a client's read lands — is THE
+    # acting_leader_id rule (alive max-term leader, lowest index on the
+    # tie; 0 = none, which no self_id matches).
+    is_acting = self_id == acting_leader_id(state, term, crashed)[None, :]
+    served = jnp.any(is_acting & holder, axis=0)
+    # dtype= keeps the served plane int32 under x64 (GC007).
+    index = jnp.sum(
+        jnp.where(is_acting & holder, commit, 0), axis=0, dtype=jnp.int32
+    )
+    return holder, served, index
 
 
 def check_safety(
@@ -468,6 +591,8 @@ def check_safety(
     crashed: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
     prev_voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
     prev_outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    lease_holder: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    lease_fire: Optional[jnp.ndarray] = None,  # gc: bool[G]
 ) -> jnp.ndarray:
     """Device-side Raft safety invariants over one round boundary.
 
@@ -507,6 +632,28 @@ def check_safety(
         most one voter may change per transition; entering joint must set
         outgoing to exactly the old incoming; leaving must clear outgoing
         with incoming untouched; while joint the masks must not move.
+
+    Linearizability slots (ISSUE 13; active only when the lease-read args
+    are given — the classic stale-read-under-partition trap of
+    leader-lease reads, machine-checked every round of the workload
+    scan):
+
+      * no stale lease read (SV_STALE_READ, needs `lease_holder` AND
+        `lease_fire`): in a round where a LeaseBased read fired, no peer
+        holding a live lease (kernels.lease_read's holder mask, computed
+        on the serve-time = round-entry state) may answer with a commit
+        index older than ANY index committed fleet-wide at serve time —
+        `prev_commit` here is exactly the round-entry commit plane, so a
+        holder with prev_commit[p] < max_p(prev_commit) would hand a
+        client a linearizability violation (a deposed-but-unaware leader
+        serving across a partition while the new majority committed);
+      * at most one live lease per group (SV_DUAL_LEASE, needs
+        `lease_holder`): two simultaneous holders means two leaders
+        would BOTH serve local reads for the same group this round —
+        unreachable without clock drift because the check-quorum
+        boundary deposes a contactless leader before the other side's
+        lease-expiry election can finish; the injected clock-pause trap
+        is exactly what makes it fire.
 
     The chaos/reconfig fuzz harnesses fold these counts into the compiled
     schedule scan every round and assert the run total is zero.
@@ -597,6 +744,28 @@ def check_safety(
         )
     else:
         sv_double = zero
+    if lease_holder is not None:
+        # dtype= on the counts: GC007 (bare bool sums widen under x64).
+        sv_dual_lease = jnp.sum(
+            jnp.sum(lease_holder, axis=0, dtype=jnp.int32) >= 2,
+            dtype=jnp.int32,
+        )
+        if lease_fire is not None:
+            fleet_high = jnp.max(prev_commit, axis=0)  # [G] at serve time
+            stale = lease_holder & (prev_commit < fleet_high[None, :])
+            sv_stale = jnp.sum(
+                lease_fire & jnp.any(stale, axis=0), dtype=jnp.int32
+            )
+        else:
+            sv_stale = zero
+    else:
+        if lease_fire is not None:
+            raise ValueError(
+                "the stale-read check needs lease_holder alongside "
+                "lease_fire"
+            )
+        sv_dual_lease = zero
+        sv_stale = zero
     # dtype= on the group counts: a bare bool sum widens to int64 under x64
     # (GC007), and these feed an int32 scan accumulator.
     return jnp.stack(
@@ -608,6 +777,8 @@ def check_safety(
             sv_outside,
             sv_unbacked,
             sv_double,
+            sv_stale,
+            sv_dual_lease,
         ]
     )
 

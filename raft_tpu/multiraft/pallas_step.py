@@ -1359,6 +1359,7 @@ def steady_mask(
     link: Optional[jnp.ndarray] = None,
     reconfig_pending: Optional[jnp.ndarray] = None,
     loss_rate: Optional[jnp.ndarray] = None,
+    read_pending: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """bool[G]: per-group steady invariant for the next `horizon` rounds —
     no election timer can fire, exactly one alive leader, every alive peer
@@ -1402,7 +1403,21 @@ def steady_mask(
     (kernels.cq_boundary_safe) applies to it even on a chaos horizon;
     only groups with a nonzero rate anywhere keep the conservative
     no-boundary-in-horizon bound.  None preserves the historical
-    all-groups conservative form byte-for-byte."""
+    all-groups conservative form byte-for-byte.
+
+    `read_pending` (optional bool[G] — workload.reads_pending_in_horizon:
+    groups with an OUTSTANDING client read, any mode, or a scheduled
+    Safe-mode fire inside the horizon) is a hard rejection like
+    reconfig_pending (ISSUE 13): the fused kernel can run neither arm of
+    the ReadIndex quorum round (the ctx-ack accumulation and the damped
+    nudge cutoff are wave logic).  Pure LEASE fires deliberately do NOT
+    reject — a lease serve touches no message planes, so a steady horizon
+    whose entry gate passes (kernels.lease_read, heartbeat_tick == 1)
+    provably serves every in-horizon lease fire at latency 0 and the
+    workload split runner folds those receipts closed-form
+    (workload.make_split_runner; fused-vs-general bit-parity in
+    tests/test_workload.py).  None keeps every existing graph
+    unchanged."""
     damped = cfg.check_quorum or cfg.pre_vote
     if damped and cfg.election_tick <= cfg.heartbeat_tick:
         # The check-quorum saturation argument needs one full heartbeat
@@ -1457,6 +1472,11 @@ def steady_mask(
     if reconfig_pending is not None:
         # 4b. no scheduled reconfig touches the horizon (see docstring).
         ok = ok & ~reconfig_pending
+    if read_pending is not None:
+        # 4c. no quorum-round read work touches the horizon (ISSUE 13;
+        # see docstring — lease fires stay fusable and are folded by the
+        # caller).
+        ok = ok & ~read_pending
     if link is not None:
         # 5. every directed link among alive peers is up (crashed peers'
         # links and self-links are dead weight either way).

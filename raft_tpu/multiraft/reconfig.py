@@ -814,12 +814,14 @@ def _runner_body(
     chaos_sched: Optional[chaos_mod.CompiledChaos],
     with_counters: bool = False,
     actions: Optional[Tuple] = None,
+    client=None,
 ):
     """One general round of the compiled reconfig(+chaos) scenario as a
     lax.scan body over the absolute round index — the SINGLE source of the
     op propose/gate/apply protocol, shared by make_runner's whole-horizon
     scan, make_split_runner's general segments / fused-block fallback,
-    and the autopilot's cadence segments (autopilot.make_cadence_runner).
+    the autopilot's cadence segments (autopilot.make_cadence_runner), and
+    the client-workload runner (workload.make_runner).
 
     Carry: (state, health, rstate, stats, rstats, safety) with an
     [N_COUNTERS] int32 plane appended when `with_counters` (the split
@@ -831,10 +833,24 @@ def _runner_body(
     bool[P, G]) triple: at the one round whose absolute index equals
     `action_round` the transfer commands and campaign kicks are handed to
     sim.step; every other round passes the zero action.  None keeps the
-    historical graphs byte-identical."""
+    historical graphs byte-identical.
+
+    `client` (ISSUE 13, the compiled client workload — a
+    workload.CompiledClient rebuilt from runtime args) appends
+    (read_carry, read_stats[workload.N_READ_STATS],
+    lat_hist[workload.N_LAT_BUCKETS]) to the carry: each round gathers
+    the schedule's read fires and append skew, retries outstanding reads
+    through `sim.step(read_propose=)`, folds per-read latency-in-rounds
+    into the on-device histogram, and runs kernels.check_safety's
+    linearizability slots (lease-holder mask off the round-ENTRY state)
+    alongside the joint-window audit.  None keeps every historical graph
+    byte-identical."""
     P, G = cfg.n_peers, cfg.n_groups
 
     def body(carry, r):
+        rcar = rdstats = lat_hist = None
+        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            carry, (rcar, rdstats, lat_hist) = carry[:-3], carry[-3:]
         if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             st, hl, rst, stats, rstats, safety, ctrs = carry
         else:
@@ -856,6 +872,36 @@ def _runner_body(
         else:
             transfer_propose = None
             campaign_kick = None
+        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            # The round's client traffic: phase append skew plus read
+            # fires (packed bits along G); an outstanding read retries
+            # every round until served, a fire finding one outstanding is
+            # dropped (one read in flight per group).
+            cph = client.phase_of_round[r]
+            append = append + client.append[cph]
+            fire_row = kernels.unpack_bits_g(client.read_fire_packed[r], G)
+            mode_row = client.read_mode[cph]
+            fire = fire_row & (mode_row > 0)
+            fresh = fire & (rcar.pending_mode == 0)
+            dropped = fire & (rcar.pending_mode > 0)
+            pmode = jnp.where(fresh, mode_row, rcar.pending_mode)
+            psince = jnp.where(fresh, r, rcar.pending_since)
+            read_propose = pmode
+            # The linearizability audit's inputs, off the round-ENTRY
+            # (= serve-time) state: the full lease-holder mask and the
+            # groups with a lease-mode read live this round.
+            lease_holder, _, _ = kernels.lease_read(
+                st.state, st.term, st.leader_id, st.election_elapsed,
+                st.commit, st.term_start_index, crashed,
+                cfg.election_tick,
+                cfg.check_quorum and cfg.lease_read, st.transferee,
+                st.recent_active, st.voter_mask, st.outgoing_mask,
+            )
+            lease_fire = pmode == sim_mod.READ_LEASE
+        else:
+            read_propose = None
+            lease_holder = None
+            lease_fire = None
         # Op eligibility: the next unapplied op, once its phase starts.
         start = _gather_op(sched.op_start, rst.op_ptr)
         active = (rst.op_ptr < sched.n_ops) & (r >= start)
@@ -868,7 +914,11 @@ def _runner_body(
             reconfig_propose=want_prop,
             transfer_propose=transfer_propose,
             campaign_kick=campaign_kick,
+            read_propose=read_propose,
         )
+        receipt = None
+        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            step_out, receipt = step_out[:-1], step_out[-1]
         if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             st2, ctrs2, hl2, prop = step_out
         else:
@@ -910,6 +960,8 @@ def _runner_body(
             crashed=crashed,
             prev_voter_mask=rst.prev_voter,
             prev_outgoing_mask=rst.prev_outgoing,
+            lease_holder=lease_holder,
+            lease_fire=lease_fire,
         )
         # The gated swap: target masks of the op being applied, the
         # reference's apply-time reactions on the batched planes.
@@ -958,6 +1010,34 @@ def _runner_body(
         out = (st3, hl2, rst2, stats, rstats, safety)
         if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             out = out + (ctrs2,)
+        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            # Serve accounting: a non-negative receipt closes the group's
+            # outstanding read with latency (r - issue_round), folded into
+            # the device histogram (bucket = min(latency, cap), cap =
+            # N_LAT_BUCKETS - 1 derived from the carry shape).
+            lat_cap = lat_hist.shape[0] - 1
+            served = (receipt.index >= 0) & (pmode > 0)
+            lat = jnp.clip(r - psince, 0, lat_cap)
+            lat_hist = lat_hist.at[jnp.where(served, lat, 0)].add(
+                served.astype(jnp.int32)
+            )
+            # dtype= on the counts: GC007 (bare bool sums widen under
+            # x64) — these feed the int32 read-stats accumulator.
+            rdstats = rdstats + jnp.stack(
+                [
+                    jnp.sum(fresh, dtype=jnp.int32),
+                    jnp.sum(served & receipt.lease, dtype=jnp.int32),
+                    jnp.sum(served & ~receipt.lease, dtype=jnp.int32),
+                    jnp.sum(served & receipt.degraded, dtype=jnp.int32),
+                    jnp.sum((pmode > 0) & ~served, dtype=jnp.int32),
+                    jnp.sum(dropped, dtype=jnp.int32),
+                ]
+            )
+            rcar = type(rcar)(
+                pending_mode=jnp.where(served, 0, pmode),
+                pending_since=jnp.where(served, 0, psince),
+            )
+            out = out + (rcar, rdstats, lat_hist)
         return out, ()
 
     return body

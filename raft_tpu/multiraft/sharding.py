@@ -214,6 +214,60 @@ def shard_reconfig(compiled, rstate, mesh: Mesh, axis: str = "groups"):
     return placed_sched, placed_rstate
 
 
+def client_sharding(mesh: Mesh, axis: str = "groups"):
+    """NamedShardings for a client-workload run's arrays (ISSUE 13): the
+    compiled schedule (workload.CompiledClient) and the outstanding-read
+    carry (workload.ReadCarry) shard on the group axis like every other
+    [.., G] plane — per-group read protocols are independent, so the
+    compiled scan partitions trivially.  The packed read-fire plane's
+    word axis IS the group axis / 32 (kernels.pack_bits_g keeps words
+    group-minor), so it shards on the same mesh axis; the round-indexed
+    phase_of_round and the fixed-size stats/latency accumulators are
+    replicated (group-free; XLA reduces the per-shard partials over
+    ICI).  Returns (schedule_shardings, carry_shardings,
+    accumulator_sharding)."""
+    from .workload import CompiledClient, ReadCarry
+
+    rep = NamedSharding(mesh, P())
+    g = NamedSharding(mesh, P(axis))
+    xg = NamedSharding(mesh, P(None, axis))
+    sched = CompiledClient(
+        phase_of_round=rep,
+        read_fire_packed=xg,
+        read_mode=xg,
+        append=xg,
+        n_peers=None,
+    )
+    rcar = ReadCarry(pending_mode=g, pending_since=g)
+    return sched, rcar, rep
+
+
+def shard_client(compiled, rcar, mesh: Mesh, axis: str = "groups"):
+    """Place a compiled client schedule + read carry on the mesh (the
+    device_put mirror of shard_state for the workload arrays).
+
+    The packed fire plane's word axis is the group axis / 32, so it
+    shards only when the word count tiles the mesh (ceil(G/32) divisible
+    by the axis size — always true at the production shapes where
+    sharding matters); otherwise it is REPLICATED, which is merely an
+    HBM cost on read-only schedule data, never a correctness one."""
+    sched_sh, rcar_sh, rep = client_sharding(mesh, axis)
+    n_dev = mesh.shape[axis]
+    if compiled.read_fire_packed.shape[1] % n_dev != 0:
+        sched_sh = sched_sh._replace(read_fire_packed=rep)
+    placed_sched = compiled._replace(
+        **{
+            name: jax.device_put(
+                getattr(compiled, name), getattr(sched_sh, name)
+            )
+            for name in compiled._fields
+            if name != "n_peers"
+        }
+    )
+    placed_rcar = jax.tree.map(jax.device_put, rcar, rcar_sh)
+    return placed_sched, placed_rcar
+
+
 def run_sharded(
     cfg: SimConfig,
     mesh: Mesh,

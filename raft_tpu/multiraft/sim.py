@@ -32,7 +32,17 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     landed), gate the mask swap on its dual-majority commit, and apply
     it in-scan via kernels.apply_confchange — composable with a chaos
     plan in the same scan (`ClusterSim.run_reconfig`);
-  * the linearizable ReadIndex barrier, Safe mode (`read_index` below);
+  * the linearizable read path, BOTH raft-rs modes (ISSUE 13): the
+    ReadIndex barrier, Safe mode (`read_index` below, link-aware; the
+    damped nudge-cutoff form in `_read_quorum_damped`), and LeaseBased
+    local serves under the check-quorum leader lease
+    (`kernels.lease_read`, enabled by SimConfig(lease_read=True)) —
+    `step(..., read_propose=)` evaluates per-group read commands on the
+    round-entry state in all three step paths and reports a ReadReceipt
+    extra (index, lease-vs-degraded), with the stale-read trap
+    machine-checked by kernels.check_safety's linearizability slots;
+    compiled client workloads drive it at scale
+    (raft_tpu/multiraft/workload.py);
   * fault injection at LINK granularity (the chaos engine,
     raft_tpu/multiraft/chaos.py): a directed reachability plane
     `link[src, dst, g]` threaded through every exchange of the round via
@@ -148,6 +158,18 @@ class SimConfig(NamedTuple):
     # static like the damping flags: the flag-off pytree and graphs are
     # bit-identical to the pre-transfer build.
     transfer: bool = False
+    # Lease-based linearizable reads (ISSUE 13): when True,
+    # step(..., read_propose=) may serve a LeaseBased read LOCALLY — zero
+    # message rounds — under the check-quorum leader lease
+    # (kernels.lease_read); when False every lease request degrades to
+    # the ReadIndex quorum round.  Mirrors the reference's
+    # Config.read_only_option == LeaseBased, including its validate rule:
+    # lease_read=True requires check_quorum=True (step() raises
+    # otherwise — without the boundary deposal the lease proves
+    # nothing).  Trace-time static: read_propose=None graphs are
+    # bit-identical regardless, and no new SimState plane exists (the
+    # lease gate reads the ISSUE 7 planes).
+    lease_read: bool = False
 
     @property
     def min_timeout(self) -> int:
@@ -255,6 +277,160 @@ class ReconfigProposal(NamedTuple):
     owner: jnp.ndarray  # gc: int32[G]
     index: jnp.ndarray  # gc: int32[G]
     term: jnp.ndarray  # gc: int32[G]
+
+
+# Read-request modes for step(..., read_propose=) — int32[G] per-group
+# commands, matching raft_tpu.read_only_option.ReadOnlyOption + 1 (0 is
+# "no read this round").
+READ_NONE = 0
+READ_SAFE = 1  # the ReadIndex quorum round (ReadOnlyOption::Safe)
+READ_LEASE = 2  # local serve under the lease (ReadOnlyOption::LeaseBased)
+
+
+class ReadReceipt(NamedTuple):
+    """What this round's client reads returned, per group (the step extra
+    behind `step(..., read_propose=)`): `index` is the commit index the
+    group's acting leader served (-1 = the read did not complete this
+    round — no alive leader, the commit_to_current_term gate, or a failed
+    ack quorum — and the caller retries it next round), `lease` marks
+    groups served LOCALLY under the check-quorum leader lease (zero
+    message rounds — the kernels.lease_read gate), and `degraded` marks
+    LeaseBased requests that fell back to the ReadIndex quorum round (the
+    DECISION, recorded even when the fallback also failed to serve).
+    Reads are probes: the receipt is computed on the round-ENTRY state
+    and the round's protocol phases never see the read traffic, exactly
+    like sim.read_index (the scalar pump's perturbation is confined to
+    the ReadOracle's throwaway copy).  simref.ReadOracle reproduces
+    index, serve round, and the degrade decision bit-for-bit
+    (tests/test_read_lease.py)."""
+
+    index: jnp.ndarray  # gc: int32[G]
+    lease: jnp.ndarray  # gc: bool[G]
+    degraded: jnp.ndarray  # gc: bool[G]
+
+
+def _read_quorum_damped(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    link: Optional[jnp.ndarray],  # gc: bool[P, P, G]
+) -> jnp.ndarray:
+    """The Safe-mode ReadIndex barrier under damping (check_quorum or
+    pre_vote): like sim.read_index, but with the low-term nudge cutoff
+    the damped scalar pump applies — a ctx heartbeat reaching a
+    HIGHER-term member draws an empty MsgAppendResponse at the member's
+    term (reference: raft.rs step's m.term < self.term arm under
+    check_quorum/pre_vote), which deposes the leader when processed;
+    become_follower's reset() WIPES the pending read queue, so the read
+    completes only if a quorum of acks lands STRICTLY BEFORE the first
+    deposing nudge in the response stream (peer-id order — the harness
+    pump's wave order).  Ack quorum evaluation happens per processed ack
+    (handle_heartbeat_response), so the joint self-quorum hang and the
+    at-least-one-responder rule fall out of the same loop.  Pure probe,
+    like read_index; returns int32[G] (-1 = not served)."""
+    G, P = cfg.n_groups, cfg.n_peers
+    alive = ~crashed
+    member = st.voter_mask | st.outgoing_mask | st.learner_mask
+    is_lead = (st.state == ROLE_LEADER) & alive
+    lead_term = jnp.max(jnp.where(is_lead, st.term, -1), axis=0)
+    # The acting leader is THE acting_leader_id rule (alive max-term,
+    # lowest index on the tie; 0 = none, matched by no peer id).
+    lead_id = kernels.acting_leader_id(st.state, st.term, crashed)
+    has_lead = lead_id > 0
+    p_idx = jnp.arange(P, dtype=jnp.int32)[:, None]
+    is_acting = (p_idx + 1) == lead_id[None, :]
+    # dtype= so the probed indices stay int32 under x64 (GC007).
+    lead_commit = jnp.sum(
+        jnp.where(is_acting, st.commit, 0), axis=0, dtype=jnp.int32
+    )
+    lead_ts = jnp.sum(
+        jnp.where(is_acting, st.term_start_index, 0), axis=0, dtype=jnp.int32
+    )
+    servable = has_lead & (lead_commit >= lead_ts)
+    n_i = jnp.sum(st.voter_mask, axis=0).astype(jnp.int32)
+    n_o = jnp.sum(st.outgoing_mask, axis=0).astype(jnp.int32)
+    singleton = (n_i == 1) & (n_o == 0)
+    q_i = n_i // 2 + 1
+    q_o = n_o // 2 + 1
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    E = alive[:, None, :] & alive[None, :, :] & off_diag
+    if link is not None:
+        E = E & link
+    reach = jnp.any(E & is_acting[:, None, :], axis=0)  # [P_m, G] l -> m
+    ret = jnp.any(E & is_acting[None, :, :], axis=1)  # [P_m, G] m -> l
+    resp = member & reach & ret & ~is_acting  # a delivered response
+    ack_v = resp & (st.term <= lead_term[None, :])
+    ndg_v = resp & (st.term > lead_term[None, :])  # the deposing nudge
+    # The leader's own ack (add_request seeds acks = {self}).
+    cnt_i = jnp.sum(
+        jnp.where(is_acting & st.voter_mask, 1, 0), axis=0, dtype=jnp.int32
+    )
+    cnt_o = jnp.sum(
+        jnp.where(is_acting & st.outgoing_mask, 1, 0), axis=0,
+        dtype=jnp.int32,
+    )
+    served = jnp.zeros((G,), bool)
+    dead = jnp.zeros((G,), bool)
+    for v in range(P):
+        # The nudge at stream position v deposes a leader not yet served;
+        # every later response is stepped by a follower and ignored.
+        dead = dead | (ndg_v[v] & ~served)
+        a = ack_v[v] & ~dead
+        cnt_i = cnt_i + (a & st.voter_mask[v]).astype(jnp.int32)
+        cnt_o = cnt_o + (a & st.outgoing_mask[v]).astype(jnp.int32)
+        quorum = ((cnt_i >= q_i) | (n_i == 0)) & (
+            (cnt_o >= q_o) | (n_o == 0)
+        )
+        # has_quorum(acks) is only EVALUATED inside
+        # handle_heartbeat_response — i.e. on processing ack `a` — which
+        # is what makes the leader-alone joint quorum hang until some
+        # other member responds (read_index's any_other rule).
+        served = served | (a & quorum)
+    ok = servable & (singleton | served)
+    return jnp.where(ok, lead_commit, jnp.int32(-1))
+
+
+def _read_phase(
+    cfg: SimConfig,
+    st: SimState,
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    read_propose: jnp.ndarray,  # gc: int32[G]
+    link: Optional[jnp.ndarray],  # gc: bool[P, P, G]
+) -> ReadReceipt:
+    """The client-read phase, shared by all three step paths: evaluate
+    this round's read requests (`read_propose[g]` in READ_* modes) on the
+    round-ENTRY state — before the transfer pump, the ticks, and every
+    protocol phase, exactly where the scalar oracle steps MsgReadIndex at
+    the acting leader.
+
+    A READ_LEASE request serves locally when the hardened lease gate
+    passes (kernels.lease_read: check-quorum leader inside its lease
+    window, committed in its own term, no transfer pending) and
+    cfg.lease_read is on; otherwise it DEGRADES to the ReadIndex quorum
+    round — the same link-aware barrier a READ_SAFE request runs
+    (read_index undamped; _read_quorum_damped's nudge-cutoff form under
+    damping).  Pure: reads touch no message planes, so the round's traced
+    protocol phases are byte-identical with or without them."""
+    want = read_propose > READ_NONE
+    lease_want = read_propose == READ_LEASE
+    _, lease_served, lease_idx = kernels.lease_read(
+        st.state, st.term, st.leader_id, st.election_elapsed, st.commit,
+        st.term_start_index, crashed, cfg.election_tick,
+        cfg.check_quorum and cfg.lease_read, st.transferee,
+        st.recent_active, st.voter_mask, st.outgoing_mask,
+    )
+    serve_l = lease_want & lease_served
+    fallback = want & ~serve_l
+    if cfg.check_quorum or cfg.pre_vote:
+        ri = _read_quorum_damped(cfg, st, crashed, link)
+    else:
+        ri = read_index(cfg, st, crashed, link)
+    index = jnp.where(
+        serve_l, lease_idx, jnp.where(fallback, ri, jnp.int32(-1))
+    )
+    return ReadReceipt(
+        index=index, lease=serve_l, degraded=lease_want & ~serve_l
+    )
 
 
 def _node_key(
@@ -818,6 +994,7 @@ def step(
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
     transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
     campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    read_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -848,12 +1025,19 @@ def step(
     REPORT where the workload landed, as a ReconfigProposal extra (owner 0
     where no alive leader acted, so the op retries next round).
 
+    read_propose: optional int32[G] — this round's client-read commands
+    (READ_* modes: 0 none, 1 Safe/ReadIndex, 2 LeaseBased), evaluated by
+    the shared _read_phase on the round-ENTRY state and reported as a
+    ReadReceipt extra.  Reads are pure probes: the round's protocol
+    phases are unchanged by them.
+
     Extras are appended to the return value in (counters, health,
-    proposal) order for whichever are given — (state,), (state, counters),
-    (state, health), (state, counters, health), each with the
-    ReconfigProposal appended when reconfig_propose is given; bare `state`
-    when none.  All choices are trace-time static: the
-    counters=None/health=None/reconfig_propose=None graph is unchanged.
+    proposal, read) order for whichever are given — (state,),
+    (state, counters), (state, health), (state, counters, health), each
+    with the ReconfigProposal appended when reconfig_propose is given and
+    the ReadReceipt when read_propose is given; bare `state` when none.
+    All choices are trace-time static: the counters=None/health=None/
+    reconfig_propose=None/read_propose=None graph is unchanged.
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -871,6 +1055,17 @@ def step(
             "construct the sim with SimConfig(transfer=True) (init_state "
             "creates it); the transfer-off pytree/graphs stay pinned"
         )
+    if cfg.lease_read and not cfg.check_quorum:
+        # The reference's Config.validate rule verbatim: without the
+        # check-quorum boundary deposal a "lease" proves nothing, so a
+        # LeaseBased configuration that skipped check_quorum is a
+        # misconfiguration, not a degraded mode.
+        raise ValueError(
+            "SimConfig(lease_read=True) requires check_quorum=True "
+            "(reference: Config.validate — read_only_option == LeaseBased "
+            "requires check_quorum); undamped sims serve reads through "
+            "the ReadIndex quorum round only"
+        )
     if cfg.check_quorum or cfg.pre_vote:
         if link is None:
             link = jnp.ones(
@@ -879,13 +1074,23 @@ def step(
         return _damped_linked_step(
             cfg, st, crashed, append_n, link, group_ids, counters, health,
             reconfig_propose, transfer_propose, campaign_kick,
+            read_propose,
         )
     if link is not None:
         return _linked_step(
             cfg, st, crashed, append_n, link, group_ids, counters, health,
             reconfig_propose, transfer_propose, campaign_kick,
+            read_propose,
         )
     G, P = cfg.n_groups, cfg.n_peers
+    # Client-read phase (ISSUE 13): pure probe on the round-entry state,
+    # reported as the trailing ReadReceipt extra; the protocol phases
+    # below never see it.
+    read_extra = (
+        None
+        if read_propose is None
+        else _read_phase(cfg, st, crashed, read_propose, None)
+    )
     # Leader-transfer pre-tick pump (ISSUE 12): runs the pending/new
     # transfer commands to quiescence BEFORE the round's ticks, exactly
     # where the scalar TransferOracle pumps them; the round's protocol
@@ -1364,7 +1569,12 @@ def step(
         recent_active=st.recent_active,
         transferee=transferee,
     )
-    if counters is None and health is None and reconfig_propose is None:
+    if (
+        counters is None
+        and health is None
+        and reconfig_propose is None
+        and read_extra is None
+    ):
         return out
     # A group wins at most one election per round (quorum uniqueness), and
     # the solo crashed-campaigner path is mutually exclusive with the
@@ -1436,6 +1646,8 @@ def step(
                 term=jnp.where(prop_mask, lead_term, 0),
             ),
         )
+    if read_extra is not None:
+        extras = extras + (read_extra,)
     return (out,) + extras
 
 
@@ -1451,6 +1663,7 @@ def _linked_step(
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
     transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
     campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    read_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
 ) -> Union[SimState, Tuple]:
     """The pairwise (link-gated) protocol round behind `step(..., link=)`.
 
@@ -1485,6 +1698,13 @@ def _linked_step(
     """
     G, P = cfg.n_groups, cfg.n_peers
     st_in = st
+    # Client-read phase (ISSUE 13): pure probe on the round-entry state,
+    # link-aware, reported as the trailing ReadReceipt extra.
+    read_extra = (
+        None
+        if read_propose is None
+        else _read_phase(cfg, st, crashed, read_propose, link)
+    )
     t_extra = None
     if st.transferee is not None:
         # The transfer pre-tick pump, link-gated (see _transfer_phase).
@@ -2019,7 +2239,12 @@ def _linked_step(
         recent_active=st.recent_active,
         transferee=transferee,
     )
-    if counters is None and health is None and reconfig_propose is None:
+    if (
+        counters is None
+        and health is None
+        and reconfig_propose is None
+        and read_extra is None
+    ):
         return out
     won_any = jnp.any(won, axis=0)
     extras: Tuple = ()
@@ -2080,6 +2305,8 @@ def _linked_step(
                 term=jnp.where(prop_mask, lead_term, 0),
             ),
         )
+    if read_extra is not None:
+        extras = extras + (read_extra,)
     return (out,) + extras
 
 
@@ -2095,6 +2322,7 @@ def _damped_linked_step(
     reconfig_propose: Optional[jnp.ndarray] = None,  # gc: bool[G]
     transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
     campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    read_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
 ) -> Union[SimState, Tuple]:
     """The damped (check-quorum / pre-vote / lease) pairwise round.
 
@@ -2138,6 +2366,15 @@ def _damped_linked_step(
         )
     G, P = cfg.n_groups, cfg.n_peers
     st_in = st
+    # Client-read phase (ISSUE 13): pure probe on the round-entry state —
+    # the lease gate plus the damped (nudge-cutoff) ReadIndex fallback —
+    # BEFORE the transfer pump and the ticks, where the scalar oracle
+    # steps MsgReadIndex.
+    read_extra = (
+        None
+        if read_propose is None
+        else _read_phase(cfg, st, crashed, read_propose, link)
+    )
     t_extra = None
     if st.transferee is not None:
         # The transfer pre-tick pump, link-gated and lease-exempt (the
@@ -3116,7 +3353,12 @@ def _damped_linked_step(
         recent_active=RA,
         transferee=transferee,
     )
-    if counters is None and health is None and reconfig_propose is None:
+    if (
+        counters is None
+        and health is None
+        and reconfig_propose is None
+        and read_extra is None
+    ):
         return out
     extras: Tuple = ()
     if counters is not None:
@@ -3185,6 +3427,8 @@ def _damped_linked_step(
                 term=jnp.where(prop_mask, lead_term, 0),
             ),
         )
+    if read_extra is not None:
+        extras = extras + (read_extra,)
     return (out,) + extras
 
 
@@ -3870,6 +4114,117 @@ class ClusterSim:
             self.health_monitor.record_reconfig(report)
         return report
 
+    # --- client-read workloads (see raft_tpu/multiraft/workload.py) ---
+
+    def run_reads(
+        self, plan, chaos_plan=None, reconfig_plan=None,
+        split: bool = False, split_k: int = 8,
+    ) -> dict:
+        """Execute a client-read workload (workload.ClientPlan or
+        CompiledClient) as ONE jitted lax.scan — read fires/retries/
+        serves (lease + ReadIndex arms), the Zipf write skew, per-read
+        latency folded into the on-device histogram, and the FULL safety
+        audit including the linearizability slots, every round —
+        optionally composed with a chaos plan and/or a reconfig plan of
+        equal length in the SAME scan.  Returns the scenario report
+        (workload.read_report: read counts, p50/p90/p99 latency in
+        rounds, MTTR, safety).
+
+        Requires SimConfig(collect_health=True); lease-mode phases serve
+        locally only under SimConfig(lease_read=True, check_quorum=True)
+        and degrade to the ReadIndex round otherwise.  The sim's state
+        and health planes advance in place; the compiled schedules and
+        scan are cached per plan triple, so repeated calls pay one
+        compile.
+
+        `split=True` (the ISSUE 13 fused satellite) executes the plan
+        through workload.make_split_runner: steady stretches whose reads
+        are pure lease serves ride the fused Pallas kernel in
+        `split_k`-round blocks (the lease receipts fold closed-form),
+        while quorum-round reads, chaos, and reconfig rounds run the
+        general per-round body — bit-identical either way, with the
+        measured `fused_frac` added to the report.  Only a bare plan
+        (no chaos/reconfig composition) supports the split mode."""
+        from . import chaos as chaos_mod
+        from . import reconfig as reconfig_mod
+        from . import workload as workload_mod
+
+        health = self._require_health()
+        cached = getattr(self, "_read_runner", None)
+        mode = ("split", split_k) if split else "scan"
+        if (
+            cached is None
+            or cached[0] is not plan
+            or cached[1] is not chaos_plan
+            or cached[2] is not reconfig_plan
+            or cached[5] != mode
+        ):
+            if isinstance(plan, workload_mod.CompiledClient):
+                compiled = plan
+            else:
+                compiled = workload_mod.compile_plan(
+                    plan, self.cfg.n_groups
+                )
+            if chaos_plan is None or isinstance(
+                chaos_plan, chaos_mod.CompiledChaos
+            ):
+                chaos_compiled = chaos_plan
+            else:
+                chaos_compiled = chaos_mod.compile_plan(
+                    chaos_plan, self.cfg.n_groups
+                )
+            if reconfig_plan is None or isinstance(
+                reconfig_plan, reconfig_mod.CompiledReconfig
+            ):
+                reconfig_compiled = reconfig_plan
+            else:
+                reconfig_compiled = reconfig_mod.compile_plan(
+                    reconfig_plan, self.cfg.n_groups
+                )
+            if split:
+                runner = workload_mod.make_split_runner(
+                    self.cfg, compiled, k=split_k,
+                    chaos_compiled=chaos_compiled,
+                    reconfig_compiled=reconfig_compiled,
+                    interpret=jax.default_backend() == "cpu",
+                )
+            else:
+                runner = workload_mod.make_runner(
+                    self.cfg, compiled, chaos_compiled, reconfig_compiled
+                )
+            self._read_runner = (
+                plan, chaos_plan, reconfig_plan, compiled, runner, mode,
+            )
+        else:
+            compiled, runner = cached[3], cached[4]
+        rst = reconfig_mod.init_reconfig_state(self.state)
+        rcar = workload_mod.init_read_carry(self.cfg.n_groups)
+        out = runner(self.state, health, rst, rcar)
+        (
+            self.state, self._health, _rst, stats, rstats, safety,
+            self._read_carry, rdstats, lat_hist,
+        ) = out[:9]
+        fused = out[9] if split else None
+        lat_p = workload_mod.latency_percentiles(lat_hist)
+        # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
+        # download of fixed-size stat vectors, outside the jitted scan.
+        rdstats_h, lat_p_h, safety_h, stats_h = jax.device_get(
+            (rdstats, lat_p, safety, stats)
+        )
+        report = workload_mod.read_report(
+            rdstats_h, lat_p_h, safety_h, stats_h, compiled.n_rounds
+        )
+        if fused is not None:
+            total = compiled.n_rounds * self.cfg.n_groups
+            # graftcheck: allow-no-host-sync-in-jit — one int32 scalar,
+            # downloaded with the report, outside the jitted segments.
+            report["fused_rounds"] = int(jax.device_get(fused))
+            report["total_rounds"] = total
+            report["fused_frac"] = round(report["fused_rounds"] / total, 4)
+        if self.health_monitor is not None:
+            self.health_monitor.record_reads(report)
+        return report
+
     def counters(self) -> dict:
         """Download the device event-counter plane as {name: count}.
 
@@ -3989,3 +4344,30 @@ class ClusterSim:
         return jax.jit(functools.partial(read_index, self.cfg))(
             self.state, crashed, link
         )
+
+    def lease_read(self, crashed=None) -> jnp.ndarray:
+        """Pure LeaseBased read probe (ISSUE 13; see kernels.lease_read):
+        int32[G] — the commit index each group's acting leader would
+        serve LOCALLY under the check-quorum lease right now, or -1 where
+        the lease gate fails (no lease-holding leader, uncommitted term,
+        pending transfer, or lease reads disabled).  Requires
+        SimConfig(lease_read=True, check_quorum=True) for a non-trivial
+        answer; zero message rounds either way.  For the full in-round
+        read path (serve + ReadIndex degrade + latency accounting) use
+        step(read_propose=) / workload.make_runner."""
+        if crashed is None:
+            crashed = jnp.zeros(
+                (self.cfg.n_peers, self.cfg.n_groups), bool
+            )
+        cfg = self.cfg
+
+        def probe(st, cr):
+            _, served, index = kernels.lease_read(
+                st.state, st.term, st.leader_id, st.election_elapsed,
+                st.commit, st.term_start_index, cr, cfg.election_tick,
+                cfg.check_quorum and cfg.lease_read, st.transferee,
+                st.recent_active, st.voter_mask, st.outgoing_mask,
+            )
+            return jnp.where(served, index, jnp.int32(-1))
+
+        return jax.jit(probe)(self.state, crashed)

@@ -12,11 +12,15 @@ schedules is THE correctness claim of the batched backend (BASELINE.json's
 
 The oracle family layers on top of ScalarCluster: HealthOracle folds the
 numpy twin of the device health planes each round; ChaosOracle replays a
-compiled fault schedule (chaos.HostSchedule) through it; ReconfigOracle
-(ISSUE 10) additionally walks a compiled membership-churn schedule
-(reconfig.HostReconfigSchedule) — proposing real conf entries, gating on
-the dual-majority commit, and applying the Changer-computed config by
-scalar surgery — the exact twin of reconfig.make_runner's scan.
+compiled fault schedule (chaos.HostSchedule) through it; TransferOracle
+(ISSUE 12) drives the real RawNode::transfer_leader pump as a pre-tick
+phase; ReadOracle (ISSUE 13) drives the real ReadOnlyOption::LeaseBased
+and Safe read pumps on throwaway deep copies for per-round receipt
+parity with sim.step(read_propose=); ReconfigOracle (ISSUE 10) walks a
+compiled membership-churn schedule (reconfig.HostReconfigSchedule) —
+proposing real conf entries, gating on the dual-majority commit, and
+applying the Changer-computed config by scalar surgery — the exact twin
+of reconfig.make_runner's scan.
 """
 
 from __future__ import annotations
@@ -506,6 +510,177 @@ class TransferOracle(HealthOracle):
                 continue
             net.peers[lead].persist()
             net.send(net.filter(net.peers[lead].read_messages()))
+
+
+class ReadOracle(TransferOracle):
+    """Scalar-side oracle for the batched client-read path (ISSUE 13):
+    drives the REAL scalar read pumps — `ReadOnlyOption::LeaseBased` for
+    lease serves and `Safe` for the ReadIndex fallback arm — with exact
+    per-round read-response parity (index, serve round, and the
+    degraded-to-ReadIndex decision) against `sim.step(read_propose=)`.
+
+    The scalar Safe probe PERTURBS its cluster (the ctx heartbeat
+    broadcast resets timers, teaches commits, and under damping its
+    low-term nudge deposes stale leaders), while the device read phase is
+    a pure probe on the round-entry state; per-round receipt parity
+    therefore runs each probe on a THROWAWAY `copy.deepcopy` of the
+    group's Network — the pump's perturbation is confined to the copy and
+    the lockstep state parity composes unchanged.  The lease DECISION
+    itself comes from `lease_gate`, the host twin of the hardened
+    `kernels.lease_read` gate (check-quorum leader naming itself, inside
+    the lease window, committed in its own term, no pending transfer, and
+    lease reads enabled): when it passes the oracle drives the LeaseBased
+    pump, when a READ_LEASE request finds it failed the oracle marks the
+    read DEGRADED and drives the Safe pump — including the
+    transfer-pending rejection, where raft-rs itself would serve (a real
+    LeaseBased soundness gap: MsgTimeoutNow's forced election bypasses
+    leases) and the hardened gate degrades instead.
+
+    Subclasses TransferOracle so transfer schedules compose: probes run
+    BEFORE the pre-tick transfer pump, exactly where the device's read
+    phase sits.
+
+    This class is the resolved GC010 oracle symbol for the lease-read
+    kernels (tools/graftcheck/parity_obligations.json: lease_read /
+    check_safety's linearizability slots -> simref.ReadOracle); renaming
+    it or its entry points is an obligation change and must go through
+    `make obligations`.
+    """
+
+    # sim.READ_* twins (workload schedules carry these codes).
+    READ_NONE = 0
+    READ_SAFE = 1
+    READ_LEASE = 2
+
+    def __init__(self, cluster: ScalarCluster, election_tick: int = 10,
+                 lease_read: bool = False, window: int = 32):
+        super().__init__(cluster, window=window)
+        self.election_tick = election_tick
+        self.lease_read = lease_read
+        self.last_receipts: Optional[list] = None
+        self._probe_seq = 0
+
+    def lease_gate(self, g: int, crashed_row) -> tuple:
+        """(acting_leader_id or None, gate bool): the host twin of
+        kernels.lease_read's holder gate evaluated at the group's acting
+        leader, from OBSERVABLE scalar state."""
+        cl = self.cluster
+        lead = cl.acting_leader(g, crashed_row)
+        if lead is None:
+            return None, False
+        r = cl.networks[g].peers[lead].raft
+        # Quorum-active-NOW: the non-clearing read of the same flags the
+        # check-quorum boundary read-and-clears (the device gate's
+        # check_quorum_active over the CURRENT recent_active row — see
+        # kernels.lease_read for why boundary-only is unsound).
+        active = {id for id, pr in r.prs.iter() if pr.recent_active}
+        active.add(r.id)
+        ok = (
+            self.lease_read
+            and r.check_quorum
+            and r.state == StateRole.Leader
+            and r.leader_id == r.id
+            and r.election_elapsed < self.election_tick
+            and not r.lead_transferee
+            and r.commit_to_current_term()
+            and r.prs.has_quorum(active)
+        )
+        return lead, ok
+
+    def _clone_group(self, g: int):
+        """deepcopy one group's Network for a throwaway probe: per-store
+        RLocks (unpicklable) are re-seeded fresh via the deepcopy memo,
+        and a shared metrics registry is dropped from the copy so the
+        probe's pump can never double-count the live cluster's events."""
+        import copy
+        import threading
+
+        net = self.cluster.networks[g]
+        memo: dict = {}
+        for iface in net.peers.values():
+            r = iface.raft
+            if r is None:
+                continue
+            store = getattr(r.raft_log, "store", None)
+            lock = getattr(store, "_lock", None)
+            if lock is not None:
+                memo[id(lock)] = threading.RLock()
+            if r.metrics is not None:
+                memo[id(r.metrics)] = None
+            # Inflights ring buffers are flat int lists preallocated to
+            # max_inflight_msgs (1 << 20 in the harness config): a naive
+            # deepcopy walks ~10M interned ints per clone.  Seed each
+            # buffer with a C-level shallow copy instead — ints are
+            # immutable, so the copy is exact and the live buffers can
+            # never be written through it.
+            for _, pr in r.prs.iter():
+                buf = pr.ins.buffer
+                memo[id(buf)] = list(buf)
+        return copy.deepcopy(net, memo)
+
+    def read_probe(self, g: int, crashed_row, link_col, mode: int) -> tuple:
+        """One group's read receipt for this round: (index, lease,
+        degraded) — the scalar twin of sim.ReadReceipt's per-group lanes.
+        Runs the real pump on a deep copy (see class docstring)."""
+        if mode == self.READ_NONE:
+            return -1, False, False
+        lead, gate = self.lease_gate(g, crashed_row)
+        lease = mode == self.READ_LEASE and gate
+        degraded = mode == self.READ_LEASE and not lease
+        if lead is None:
+            return -1, False, degraded
+        from ..read_only_option import ReadOnlyOption
+
+        net = self._clone_group(g)
+        self.cluster._apply_crash_mask(net, crashed_row, link_col)
+        iface = net.peers[lead]
+        iface.raft.read_only.option = (
+            ReadOnlyOption.LeaseBased if lease else ReadOnlyOption.Safe
+        )
+        self._probe_seq += 1
+        ctx = b"read-%d" % self._probe_seq
+        before = len(iface.raft.read_states)
+        net.send([
+            Message(
+                msg_type=MessageType.MsgReadIndex,
+                from_=lead,
+                to=lead,
+                entries=[Entry(data=ctx)],
+            )
+        ])
+        rs = iface.raft.read_states
+        if len(rs) > before and bytes(rs[-1].request_ctx) == ctx:
+            return rs[-1].index, lease, degraded
+        return -1, lease, degraded
+
+    def round(self, crashed=None, append_n=None, link=None,
+              conf_propose=None, kick=None, transfer_propose=None,
+              read_propose=None):
+        """One lockstep round with optional per-group read commands
+        (`read_propose[g]` in READ_* codes).  Probes run FIRST — on the
+        round-entry state, before the transfer pump and the ticks, where
+        the device read phase sits — and land in `self.last_receipts` as
+        [(index, lease, degraded)] per group (None when read_propose is
+        None)."""
+        G, P = self.cluster.n_groups, self.cluster.n_peers
+        if crashed is None:
+            crashed = np.zeros((G, P), dtype=bool)
+        if read_propose is None:
+            self.last_receipts = None
+        else:
+            self.last_receipts = [
+                self.read_probe(
+                    g,
+                    crashed[g],
+                    None if link is None else link[:, :, g],
+                    int(read_propose[g]),
+                )
+                for g in range(G)
+            ]
+        return super().round(
+            crashed, append_n, link, conf_propose, kick=kick,
+            transfer_propose=transfer_propose,
+        )
 
 
 class ReconfigOracle(HealthOracle):

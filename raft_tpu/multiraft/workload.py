@@ -1,0 +1,775 @@
+"""Compiled client workloads: Zipf-skewed read/write mixes driven through
+the batched sim as ONE jitted lax.scan (ISSUE 13).
+
+A :class:`ClientPlan` is the client-side twin of a chaos.ChaosPlan: a list
+of phases, each covering a round range and a group selector, declaring the
+phase's WRITE load (uniform `append` or a seeded Zipf draw per group — the
+TiKV-style hot-region skew) and its READ traffic (a read issued every
+`read_every` rounds per selected group, in `read_mode` "safe" — the
+ReadIndex quorum round — or "lease" — the LeaseBased local serve under the
+check-quorum leader lease).  :func:`compile_plan` lowers it host-side into
+dense schedule arrays (per-round read-fire masks bit-packed 32:1 along G —
+GC008 PACKED_PLANES `bits_g`); :func:`make_runner` then executes the whole
+scenario inside one ``lax.scan`` with zero host round trips, composable
+with a ``chaos.CompiledChaos`` AND a ``reconfig.CompiledReconfig`` in the
+SAME scan (reads during partitions, reads during joint config —
+``reconfig._runner_body`` is the shared round body).
+
+Each round: outstanding reads retry through ``sim.step(read_propose=)``
+(one read in flight per group; a fire landing on an outstanding read is
+dropped and counted), a served read folds its latency-in-rounds into an
+on-device histogram (`N_LAT_BUCKETS` buckets, overflow-capped), and
+``kernels.check_safety``'s linearizability slots (SV_STALE_READ /
+SV_DUAL_LEASE) audit the lease-holder mask every round.  The histogram
+reduces ON DEVICE to p50/p90/p99 via :func:`latency_percentiles` — the
+nearest-rank rule of profiling.RoundTimer._percentile — so only a
+fixed-size report ever crosses to the host.
+
+Plan JSON (see docs/OBSERVABILITY.md "Reads" and examples/reads/)::
+
+    {"name": "zipf-mixed", "peers": 5, "seed": 7, "phases": [
+        {"rounds": 64, "append": 1},                       # settle, no reads
+        {"rounds": 128, "write_zipf": 1.8, "write_max": 8,
+         "read_every": 2, "read_mode": "lease"},
+        {"rounds": 64, "read_every": 1, "read_mode": "safe",
+         "groups": {"mod": 2, "eq": 0}}]}
+
+The scalar twin is simref.ReadOracle (per-round receipt parity on the real
+LeaseBased/Safe pumps); :class:`HostClientSchedule` is the numpy half the
+oracle-driven tests walk — built by the SAME `_compile_arrays` walk as the
+device schedule, so the two cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import chaos as chaos_mod
+from . import kernels
+from . import reconfig as reconfig_mod
+from . import sim as sim_mod
+from .chaos import GroupSel, _group_mask
+
+
+_MODE_CODES = {"safe": sim_mod.READ_SAFE, "lease": sim_mod.READ_LEASE}
+
+# Read-stats accumulator indices ([N_READ_STATS] int32; each slot grows by
+# at most G per round and compile_plan bounds rounds x G < 2**31 — the
+# GC008 no-wrap argument, derived in docs/STATIC_ANALYSIS.md).
+RS_ISSUED = 0  # fresh reads accepted (fires finding no outstanding read)
+RS_SERVED_LEASE = 1  # reads served locally under the lease gate
+RS_SERVED_QUORUM = 2  # reads served through the ReadIndex quorum round
+RS_DEGRADED_SERVES = 3  # lease requests that served via the fallback
+RS_RETRY_ROUNDS = 4  # (group, round) pairs an outstanding read waited
+RS_DROPPED_FIRES = 5  # fires dropped because a read was already in flight
+N_READ_STATS = 6
+
+READ_STAT_NAMES = (
+    "reads_issued",
+    "served_lease",
+    "served_quorum",
+    "degraded_serves",
+    "retry_group_rounds",
+    "dropped_fires",
+)
+
+# Latency histogram: bucket i counts reads served i rounds after issue;
+# the last bucket accumulates every latency >= LAT_CAP.  int32 counts,
+# bounded by the same rounds x G < 2**31 compile-time assert.
+LAT_CAP = 64
+N_LAT_BUCKETS = LAT_CAP + 1
+
+
+@dataclass
+class ClientPhase:
+    """One contiguous stretch of rounds with a fixed client traffic mix.
+
+    rounds:     phase length in protocol rounds (>= 1).
+    append:     uniform per-round write load at each selected group's
+                leader (ignored when write_zipf > 0).
+    write_zipf: Zipf skew parameter (> 1); when set, each selected group
+                draws its per-round write load once for the phase from
+                numpy's zipf(a), clipped to write_max — the hot-region
+                skew of benches/suites.py config 3.
+    write_max:  clip bound for the Zipf draw.
+    read_every: issue a read every N rounds per selected group (0 = no
+                reads this phase).
+    read_mode:  "safe" (ReadIndex quorum round) or "lease" (LeaseBased
+                local serve; degrades to safe where the gate fails).
+    stagger:    offset each group's fire cadence by its group id so the
+                fleet's reads spread across rounds (True, the default)
+                instead of firing in lockstep.
+    groups:     which groups the phase's traffic applies to.
+    """
+
+    rounds: int
+    append: int = 0
+    write_zipf: float = 0.0
+    write_max: int = 8
+    read_every: int = 0
+    read_mode: str = "safe"
+    stagger: bool = True
+    groups: GroupSel = "all"
+
+
+@dataclass
+class ClientPlan:
+    """A named multi-phase client workload (host-side, declarative)."""
+
+    name: str
+    n_peers: int
+    phases: List[ClientPhase] = field(default_factory=list)
+    seed: int = 0
+
+    @property
+    def n_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+
+def plan_from_dict(doc: Dict[str, object]) -> ClientPlan:
+    """Build a ClientPlan from its JSON document form (see module doc)."""
+    phases: List[ClientPhase] = []
+    for i, ph in enumerate(doc["phases"]):  # type: ignore[index]
+        if not isinstance(ph, dict):
+            raise ValueError(f"phase {i} is not an object: {ph!r}")
+        mode = str(ph.get("read_mode", "safe"))
+        if mode not in _MODE_CODES:
+            raise ValueError(
+                f"phase {i}: read_mode {mode!r} is not one of "
+                f"{sorted(_MODE_CODES)}"
+            )
+        phases.append(
+            ClientPhase(
+                rounds=int(ph["rounds"]),  # type: ignore[arg-type]
+                append=int(ph.get("append", 0)),  # type: ignore[arg-type]
+                write_zipf=float(ph.get("write_zipf", 0.0)),  # type: ignore[arg-type]
+                write_max=int(ph.get("write_max", 8)),  # type: ignore[arg-type]
+                read_every=int(ph.get("read_every", 0)),  # type: ignore[arg-type]
+                read_mode=mode,
+                stagger=bool(ph.get("stagger", True)),
+                groups=ph.get("groups", "all"),  # type: ignore[arg-type]
+            )
+        )
+    return ClientPlan(
+        name=str(doc.get("name", "unnamed")),
+        n_peers=int(doc["peers"]),  # type: ignore[arg-type]
+        phases=phases,
+        seed=int(doc.get("seed", 0)),  # type: ignore[arg-type]
+    )
+
+
+def load_plan(path: str) -> ClientPlan:
+    """Load a ClientPlan from a JSON file (the bench.py --reads input)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return plan_from_dict(json.load(f))
+
+
+class CompiledClient(NamedTuple):
+    """Device schedule arrays for one client plan at one batch shape.
+
+    phase_of_round:   int32[R]           round -> phase index
+    read_fire_packed: uint32[R, Wg]      per-round read-issue mask,
+                                         bit-packed 32:1 along the GROUP
+                                         axis (kernels.pack_bits_g —
+                                         GC008 PACKED_PLANES `bits_g`;
+                                         Wg = ceil(G/32))
+    read_mode:        int32[NPH, G]      sim.READ_* code per phase (0
+                                         where the phase reads nothing)
+    append:           int32[NPH, G]      per-phase per-group write load
+                                         (the seeded Zipf draw baked in)
+    n_peers:          static python int
+    """
+
+    phase_of_round: jnp.ndarray  # gc: int32[R]
+    read_fire_packed: jnp.ndarray  # gc: uint32[R, WG]
+    read_mode: jnp.ndarray  # gc: int32[NPH, G]
+    append: jnp.ndarray  # gc: int32[NPH, G]
+    n_peers: int
+
+    @property
+    def n_rounds(self) -> int:
+        return int(self.phase_of_round.shape[0])
+
+
+def _compile_arrays(plan: ClientPlan, n_groups: int):
+    """The numpy schedule (shared by the device path and the oracle-side
+    HostClientSchedule — one walk, so the twins cannot drift).  The Zipf
+    write draws come from ONE RandomState(plan.seed) consumed in phase
+    order: replaying the same plan always produces the same skew."""
+    G = n_groups
+    nph = len(plan.phases)
+    if nph == 0:
+        raise ValueError("plan has no phases")
+    R = plan.n_rounds
+    phase_of_round = np.zeros(R, dtype=np.int32)
+    read_fire = np.zeros((R, G), dtype=bool)
+    read_mode = np.zeros((nph, G), dtype=np.int32)
+    append = np.zeros((nph, G), dtype=np.int32)
+    rng = np.random.RandomState(plan.seed)
+    gid = np.arange(G)
+    r0 = 0
+    for i, ph in enumerate(plan.phases):
+        if ph.rounds < 1:
+            raise ValueError(f"phase {i}: rounds must be >= 1")
+        phase_of_round[r0 : r0 + ph.rounds] = i
+        gsel = _group_mask(ph.groups, G)
+        if ph.write_zipf > 0.0:
+            if ph.write_zipf <= 1.0:
+                raise ValueError(
+                    f"phase {i}: write_zipf must be > 1 (numpy zipf)"
+                )
+            draws = np.minimum(
+                rng.zipf(ph.write_zipf, size=G), ph.write_max
+            ).astype(np.int32)
+        else:
+            draws = np.full(G, ph.append, dtype=np.int32)
+        append[i] = np.where(gsel, draws, 0)
+        if ph.read_every > 0:
+            read_mode[i] = np.where(gsel, _MODE_CODES[ph.read_mode], 0)
+            off = gid % ph.read_every if ph.stagger else np.zeros(G, int)
+            for o in range(ph.rounds):
+                read_fire[r0 + o] = gsel & (
+                    (o + off) % ph.read_every == 0
+                )
+        r0 += ph.rounds
+    # The read stats / latency histogram sum per-group indicators over the
+    # run in int32; bound the schedule so they provably cannot wrap (the
+    # GC008 discipline, derived in docs/STATIC_ANALYSIS.md "Read planes").
+    if R * max(1, G) >= 2**31:
+        raise ValueError(
+            f"plan spans {R} rounds x {G} groups >= 2**31 (group, round) "
+            "pairs; the int32 read-stats/latency accumulators could wrap "
+            "— split the plan"
+        )
+    return phase_of_round, read_fire, read_mode, append
+
+
+def compile_plan(plan: ClientPlan, n_groups: int) -> CompiledClient:
+    """Lower a ClientPlan to device schedule arrays for `n_groups` groups
+    (fire masks packed along G — see CompiledClient)."""
+    phase_of_round, read_fire, read_mode, append = _compile_arrays(
+        plan, n_groups
+    )
+    return CompiledClient(
+        phase_of_round=jnp.asarray(phase_of_round, dtype=jnp.int32),
+        read_fire_packed=kernels.pack_bits_g(
+            jnp.asarray(read_fire, dtype=bool)
+        ),
+        read_mode=jnp.asarray(read_mode, dtype=jnp.int32),
+        append=jnp.asarray(append, dtype=jnp.int32),
+        n_peers=plan.n_peers,
+    )
+
+
+class HostClientSchedule:
+    """The compiled client schedule kept in numpy — what the oracle-driven
+    parity tests walk.  Round r's traffic is exactly what the runner's
+    scan body gathers: the round's fire row, the phase's mode row, and the
+    phase's append row."""
+
+    def __init__(self, plan: ClientPlan, n_groups: int):
+        (
+            self.phase_of_round,
+            self.read_fire,
+            self.read_mode,
+            self.append,
+        ) = _compile_arrays(plan, n_groups)
+        self.n_rounds = plan.n_rounds
+        self.n_peers = plan.n_peers
+        self.n_groups = n_groups
+
+    def masks(self, round_idx: int):
+        """(fire[G] bool, mode[G] int32, append[G] int32) for one round."""
+        ph = int(self.phase_of_round[round_idx])
+        return (
+            self.read_fire[round_idx],
+            self.read_mode[ph],
+            self.append[ph],
+        )
+
+
+class ReadCarry(NamedTuple):
+    """The runner's per-group outstanding-read carry: `pending_mode` is
+    the sim.READ_* code of the read in flight (0 = none — one read per
+    group at a time; new fires drop), `pending_since` the absolute round
+    it was issued (latency = serve round - pending_since).  Persisted by
+    checkpoint.save_read_state; values bounded by the mode codes and the
+    plan's round count (GC008 READ_PLANES registry)."""
+
+    pending_mode: jnp.ndarray  # gc: int32[G]
+    pending_since: jnp.ndarray  # gc: int32[G]
+
+
+def init_read_carry(n_groups: int) -> ReadCarry:
+    """Fresh no-reads-outstanding carry."""
+    return ReadCarry(
+        pending_mode=jnp.zeros((n_groups,), jnp.int32),
+        pending_since=jnp.zeros((n_groups,), jnp.int32),
+    )
+
+
+def latency_percentiles(
+    hist: jnp.ndarray,  # gc: int32[L]
+    qs: Tuple[int, ...] = (50, 90, 99),
+) -> jnp.ndarray:
+    """Nearest-rank percentiles of the latency histogram, ON DEVICE: the
+    smallest bucket with at least ceil(q/100 * N) of the N served reads
+    at or below it — exactly profiling.RoundTimer._percentile's rule
+    lifted from a sorted sample list to the histogram.  Returns
+    int32[len(qs)], -1 everywhere when no read was served.
+
+    The rank math decomposes n = 100a + b so a*q + ceil(b*q/100) never
+    leaves int32 (n < 2**31 by compile_plan's bound, q <= 100; a naive
+    n*q would wrap for n > ~21M served reads)."""
+    n = jnp.sum(hist)
+    cum = jnp.cumsum(hist)
+    out = []
+    for q in qs:
+        a, b = n // 100, n % 100
+        rank = a * jnp.int32(q) + (b * jnp.int32(q) + 99) // 100
+        idx = jnp.sum(cum < rank, dtype=jnp.int32)
+        out.append(jnp.where(n == 0, jnp.int32(-1), idx))
+    return jnp.stack(out)
+
+
+def host_latency_percentile(samples, q: int) -> int:
+    """Host twin of latency_percentiles for the tests: delegates to THE
+    nearest-rank rule (profiling.RoundTimer._percentile) over the raw
+    latency sample list, so the device reduction is pinned against the
+    single source of the formula."""
+    from ..profiling import RoundTimer
+
+    xs = sorted(samples)
+    if not xs:
+        return -1
+    return RoundTimer._percentile(xs, q / 100)
+
+
+def _validate(cfg, client, chaos_compiled, reconfig_compiled):
+    if client.n_peers != cfg.n_peers:
+        raise ValueError(
+            f"client plan is for {client.n_peers} peers but the sim has "
+            f"{cfg.n_peers}"
+        )
+    R = client.n_rounds
+    if chaos_compiled is not None and chaos_compiled.n_rounds != R:
+        raise ValueError(
+            f"chaos schedule spans {chaos_compiled.n_rounds} rounds but "
+            f"the client plan spans {R} — compose equal-length plans"
+        )
+    if reconfig_compiled is not None and reconfig_compiled.n_rounds != R:
+        raise ValueError(
+            f"reconfig schedule spans {reconfig_compiled.n_rounds} rounds "
+            f"but the client plan spans {R} — compose equal-length plans"
+        )
+
+
+def make_runner(
+    cfg: sim_mod.SimConfig,
+    client: CompiledClient,
+    chaos_compiled: Optional[chaos_mod.CompiledChaos] = None,
+    reconfig_compiled=None,
+):
+    """Build the jitted whole-scenario client-workload runner: ONE
+    lax.scan over every round — read fires/retries/serves, the Zipf write
+    skew, the latency-histogram fold, the MTTR stats, and the FULL safety
+    audit (joint-window + linearizability slots, every round) — with zero
+    host round trips, optionally composed with a chaos schedule and/or a
+    reconfig schedule of equal length in the SAME scan
+    (reconfig._runner_body is the shared round body; a missing reconfig
+    plan runs the no-op schedule, whose op protocol provably never moves).
+
+    Like every compiled runner, the schedule arrays enter the jit as
+    RUNTIME ARGUMENTS (GC012) — only shapes specialize the compile.
+    Returns a callable (state, health, rstate, read_carry) ->
+    (state', health', rstate', stats[N_CHAOS_STATS],
+    rstats[N_RECONFIG_STATS], safety[N_SAFETY], read_carry',
+    read_stats[N_READ_STATS], lat_hist[N_LAT_BUCKETS]);
+    state/health/rstate/read_carry are donated.  ``runner.jitted`` /
+    ``runner.schedule_args`` are exposed for the graftcheck trace audit.
+    """
+    _validate(cfg, client, chaos_compiled, reconfig_compiled)
+    if reconfig_compiled is None:
+        from .autopilot import empty_reconfig_schedule
+
+        reconfig_compiled = empty_reconfig_schedule(
+            client.n_rounds, cfg.n_peers, cfg.n_groups
+        )
+    n_rounds = client.n_rounds
+
+    def run(st, hl, rst, rcar, *sched_args):
+        csched = client._replace(
+            phase_of_round=sched_args[0],
+            read_fire_packed=sched_args[1],
+            read_mode=sched_args[2],
+            append=sched_args[3],
+        )
+        sched, chaos_sched = reconfig_mod._rebuild_scheds(
+            reconfig_compiled, chaos_compiled, sched_args[4:]
+        )
+        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
+        rstats = jnp.zeros((reconfig_mod.N_RECONFIG_STATS,), jnp.int32)
+        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        rdstats = jnp.zeros((N_READ_STATS,), jnp.int32)
+        lat_hist = jnp.zeros((N_LAT_BUCKETS,), jnp.int32)
+        body = reconfig_mod._runner_body(
+            cfg, sched, chaos_sched, client=csched
+        )
+        carry, _ = jax.lax.scan(
+            body,
+            (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist),
+            jnp.arange(n_rounds, dtype=jnp.int32),
+        )
+        stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats, lat_hist = (
+            carry
+        )
+        # The same tail audit as reconfig.make_runner: a final-round
+        # apply's mask transition is checked one round later, so fold
+        # once more on the final state (commit checks inert).
+        safety = safety + kernels.check_safety(
+            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+            stf.commit,
+            voter_mask=stf.voter_mask,
+            outgoing_mask=stf.outgoing_mask,
+            matched=stf.matched,
+            prev_voter_mask=rstf.prev_voter,
+            prev_outgoing_mask=rstf.prev_outgoing,
+        )
+        return (
+            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
+            lat_hist,
+        )
+
+    jitted = jax.jit(run, donate_argnums=(0, 1, 2, 3))
+    schedule_args = (
+        client.phase_of_round, client.read_fire_packed, client.read_mode,
+        client.append,
+        reconfig_compiled.phase_of_round, reconfig_compiled.append,
+        reconfig_compiled.op_start, reconfig_compiled.n_ops,
+        reconfig_compiled.tgt_voter, reconfig_compiled.tgt_outgoing,
+        reconfig_compiled.tgt_learner, reconfig_compiled.added,
+        reconfig_compiled.removed,
+    ) + (
+        (
+            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
+            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
+            chaos_compiled.append,
+        )
+        if chaos_compiled is not None
+        else ()
+    )
+
+    def runner(st, hl, rst, rcar):
+        return jitted(st, hl, rst, rcar, *schedule_args)
+
+    runner.jitted = jitted  # type: ignore[attr-defined]
+    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
+    return runner
+
+
+def make_split_runner(
+    cfg: sim_mod.SimConfig,
+    client: CompiledClient,
+    k: int = 8,
+    chaos_compiled=None,
+    reconfig_compiled=None,
+    interpret: bool = False,
+):
+    """Build the FUSED client-workload runner (the ISSUE 13 perf
+    satellite): the same protocol and accounting as make_runner —
+    bit-identical end state, health planes, read stats, latency
+    histogram, and safety accumulators (tests/test_workload.py pins it) —
+    but executed as k-round blocks, each a lax.cond between the fused
+    Pallas steady kernel and the same k general rounds.
+
+    A block rides the fused kernel when, at runtime: the steady invariant
+    holds for the whole horizon (pallas_step.steady_mask, including the
+    damping conditions when check_quorum is on) AND no quorum-round read
+    work touches it (`steady_mask(read_pending=
+    reads_pending_in_horizon(...))` — an outstanding read of any mode or
+    a scheduled Safe-mode fire rejects) AND every scheduled LEASE fire is
+    provably servable — the block spans one client phase, the group's
+    acting leader passes the lease gate at block entry
+    (kernels.lease_read), and heartbeat_tick == 1 re-saturates the
+    recent_active row every round, so the gate provably holds at every
+    round entry of a steady horizon.  The fused arm then folds the lease
+    receipts CLOSED-FORM: every fire serves the round it fires (latency
+    0 — lat_hist[0] += fires; issued/served_lease += fires), the
+    outstanding-read carry provably stays empty, and every safety slot —
+    including the linearizability pair — is provably zero (one leader,
+    one lease holder, serve index = the group max commit).
+
+    Composition with chaos/reconfig schedules is NOT supported here
+    (pass them to make_runner; the reconfig split machinery is
+    reconfig.make_split_runner) — a bare plan is exactly the bench
+    --reads shape.  Returns a callable with make_runner's signature plus
+    a trailing fused-group-rounds int32 scalar:
+    (st, hl, rst, rcar) -> (..., lat_hist, fused_rounds).
+    ``runner.fused_jit`` / ``runner.schedule_args`` are exposed for the
+    graftcheck trace audit."""
+    from . import pallas_step
+
+    if chaos_compiled is not None or reconfig_compiled is not None:
+        raise ValueError(
+            "make_split_runner runs bare client plans; compose chaos/"
+            "reconfig schedules through make_runner (or the reconfig "
+            "split machinery) instead"
+        )
+    if not cfg.collect_health:
+        raise ValueError(
+            "make_split_runner needs SimConfig(collect_health=True) — "
+            "the MTTR stats and the fused block's closed-form fold ride "
+            "on the health planes"
+        )
+    if k > cfg.health_window:
+        raise ValueError(
+            f"fused block k={k} exceeds health_window="
+            f"{cfg.health_window}: the closed-form health fold handles "
+            "at most one churn-window crossing per block"
+        )
+    _validate(cfg, client, None, None)
+    from .autopilot import empty_reconfig_schedule
+
+    reconfig_sched = empty_reconfig_schedule(
+        client.n_rounds, cfg.n_peers, cfg.n_groups
+    )
+    n_rounds = client.n_rounds
+    P, G = cfg.n_peers, cfg.n_groups
+    n_blocks, tail = n_rounds // k, n_rounds % k
+    fused_fn = pallas_step.steady_round(
+        cfg, rounds=k, with_health=True, interpret=interpret
+    )
+
+    def _rebuild(sched_args):
+        csched = client._replace(
+            phase_of_round=sched_args[0],
+            read_fire_packed=sched_args[1],
+            read_mode=sched_args[2],
+            append=sched_args[3],
+        )
+        sched, _ = reconfig_mod._rebuild_scheds(
+            reconfig_sched, None, sched_args[4:]
+        )
+        return csched, sched
+
+    def block_run(
+        st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
+        fused, r0, *sched_args,
+    ):
+        csched, sched = _rebuild(sched_args)
+        body = reconfig_mod._runner_body(cfg, sched, None, client=csched)
+        crashed = jnp.zeros((P, G), bool)
+        cph = csched.phase_of_round[r0]
+        append = sched.append[sched.phase_of_round[r0]] + csched.append[cph]
+        same_phase = cph == csched.phase_of_round[r0 + k - 1]
+        read_block = reads_pending_in_horizon(csched, rcar, r0, k)
+        n_lease, any_lease = lease_fires_in_block(csched, r0, k)
+        _, lease_entry, _ = kernels.lease_read(
+            st.state, st.term, st.leader_id, st.election_elapsed,
+            st.commit, st.term_start_index, crashed, cfg.election_tick,
+            cfg.check_quorum and cfg.lease_read, st.transferee,
+            st.recent_active, st.voter_mask, st.outgoing_mask,
+        )
+        # A lease fire is provably servable across the block when the
+        # gate passes at entry and the per-round heartbeat acks keep the
+        # recent_active row saturated between boundary clears — which
+        # needs heartbeat_tick == 1 (static); otherwise lease blocks
+        # honestly fall back.
+        lease_prov = ~any_lease | (
+            lease_entry
+            if cfg.heartbeat_tick == 1
+            else jnp.zeros((G,), bool)
+        )
+        mask = pallas_step.steady_mask(
+            cfg, st, crashed, horizon=k, read_pending=read_block
+        )
+        pred = jnp.all(mask & lease_prov) & same_phase
+
+        def fast(args):
+            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat = args
+            prev_ll = hl.planes[kernels.HP_LEADERLESS]
+            st2, hl2 = fused_fn(st, crashed, append, hl)
+            stats2 = chaos_mod.update_chaos_stats(
+                stats, prev_ll, hl2.planes[kernels.HP_LEADERLESS]
+            )
+            # The op protocol provably never moves (no-op schedule); only
+            # the transition-audit anchors refresh, like the reconfig
+            # split runner's fast arm.
+            rst2 = rst._replace(
+                prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
+            )
+            # Closed-form receipts: every in-block lease fire issues
+            # fresh (the carry is provably empty — read_block rejected
+            # otherwise) and serves the round it fires at latency 0.
+            n_served = jnp.sum(n_lease, dtype=jnp.int32)
+            lat = lat.at[0].add(n_served)
+            rdstats2 = rdstats.at[RS_ISSUED].add(n_served)
+            rdstats2 = rdstats2.at[RS_SERVED_LEASE].add(n_served)
+            return (
+                st2, hl2, rst2, stats2, rstats, safety, rcar, rdstats2,
+                lat,
+            )
+
+        def slow(args):
+            carry, _ = jax.lax.scan(
+                body, args, r0 + jnp.arange(k, dtype=jnp.int32)
+            )
+            return carry
+
+        args = (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist)
+        carry = jax.lax.cond(pred, fast, slow, args)
+        fused = fused + jnp.where(pred, jnp.int32(k * G), jnp.int32(0))
+        return carry + (fused,)
+
+    def tail_run(
+        st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
+        fused, r0, *sched_args,
+    ):
+        csched, sched = _rebuild(sched_args)
+        body = reconfig_mod._runner_body(cfg, sched, None, client=csched)
+        carry, _ = jax.lax.scan(
+            body,
+            (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist),
+            r0 + jnp.arange(tail, dtype=jnp.int32),
+        )
+        return carry + (fused,)
+
+    donate = (0, 1, 2, 6)
+    fused_jit = jax.jit(block_run, donate_argnums=donate)
+    tail_jit = jax.jit(tail_run, donate_argnums=donate) if tail else None
+    schedule_args = (
+        client.phase_of_round, client.read_fire_packed, client.read_mode,
+        client.append,
+        reconfig_sched.phase_of_round, reconfig_sched.append,
+        reconfig_sched.op_start, reconfig_sched.n_ops,
+        reconfig_sched.tgt_voter, reconfig_sched.tgt_outgoing,
+        reconfig_sched.tgt_learner, reconfig_sched.added,
+        reconfig_sched.removed,
+    )
+
+    def runner(st, hl, rst, rcar):
+        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
+        rstats = jnp.zeros((reconfig_mod.N_RECONFIG_STATS,), jnp.int32)
+        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        rdstats = jnp.zeros((N_READ_STATS,), jnp.int32)
+        lat_hist = jnp.zeros((N_LAT_BUCKETS,), jnp.int32)
+        carry = (
+            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
+            jnp.int32(0),
+        )
+        for b in range(n_blocks):
+            carry = fused_jit(
+                *carry, jnp.int32(b * k), *schedule_args
+            )
+        if tail_jit is not None:
+            carry = tail_jit(
+                *carry, jnp.int32(n_blocks * k), *schedule_args
+            )
+        (
+            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
+            lat_hist, fused,
+        ) = carry
+        # make_runner's tail audit (a final-round apply transition —
+        # inert here with the no-op schedule, kept for bit-parity).
+        safety = safety + kernels.check_safety(
+            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+            stf.commit,
+            voter_mask=stf.voter_mask,
+            outgoing_mask=stf.outgoing_mask,
+            matched=stf.matched,
+            prev_voter_mask=rstf.prev_voter,
+            prev_outgoing_mask=rstf.prev_outgoing,
+        )
+        return (
+            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
+            lat_hist, fused,
+        )
+
+    runner.fused_jit = fused_jit  # type: ignore[attr-defined]
+    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
+    return runner
+
+
+def reads_pending_in_horizon(
+    client: CompiledClient,
+    rcar: ReadCarry,
+    r0: jnp.ndarray,  # gc: int32[]
+    horizon: int,
+) -> jnp.ndarray:
+    """bool[G]: the group has quorum-round read work somewhere inside
+    [r0, r0 + horizon) — an OUTSTANDING read (any mode: it must retry
+    every round) or a scheduled SAFE-mode fire.  This is the fused
+    horizon's read rejection mask (pallas_step.steady_mask's
+    `read_pending=`): the fused kernel can serve neither arm of the
+    quorum round, while pure LEASE fires are NOT pending — on a steady
+    horizon the lease gate provably holds and the serve touches no
+    message planes, so those fold closed-form (workload.make_split_runner
+    / bench --reads)."""
+    G = rcar.pending_mode.shape[0]
+    pending = rcar.pending_mode > 0
+    safe_fire = jnp.zeros((G,), bool)
+    R = client.n_rounds
+    for o in range(horizon):
+        r = jnp.clip(r0 + o, 0, R - 1)
+        fire = kernels.unpack_bits_g(client.read_fire_packed[r], G)
+        mode = client.read_mode[client.phase_of_round[r]]
+        safe_fire = safe_fire | (
+            fire & (mode == sim_mod.READ_SAFE) & ((r0 + o) < R)
+        )
+    return pending | safe_fire
+
+
+def lease_fires_in_block(
+    client: CompiledClient,
+    r0: jnp.ndarray,  # gc: int32[]
+    horizon: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(n_lease int32[G], any bool[G]): scheduled LEASE-mode fires per
+    group inside [r0, r0 + horizon) — the closed-form serve count a fused
+    block folds into the latency histogram's zero bucket (a lease serve
+    on a steady horizon completes the round it fires)."""
+    G = client.read_mode.shape[1]
+    n = jnp.zeros((G,), jnp.int32)
+    R = client.n_rounds
+    for o in range(horizon):
+        r = jnp.clip(r0 + o, 0, R - 1)
+        fire = kernels.unpack_bits_g(client.read_fire_packed[r], G)
+        mode = client.read_mode[client.phase_of_round[r]]
+        n = n + (
+            fire & (mode == sim_mod.READ_LEASE) & ((r0 + o) < R)
+        ).astype(jnp.int32)
+    return n, n > 0
+
+
+def read_report(
+    rdstats, lat_p, safety, stats, rounds: int
+) -> dict:
+    """The per-scenario read-workload summary off the device accumulators
+    (host-side formatter; bench.py --reads and ClusterSim.run_reads emit
+    it).  `lat_p` is latency_percentiles' (p50, p90, p99) vector."""
+    from .chaos import CS_HEALED_ROUNDS, CS_MAX_STREAK, CS_REELECTIONS
+    from .kernels import SAFETY_NAMES
+
+    reelections = int(stats[CS_REELECTIONS])
+    healed = int(stats[CS_HEALED_ROUNDS])
+    return {
+        "rounds": int(rounds),
+        **{name: int(v) for name, v in zip(READ_STAT_NAMES, rdstats)},
+        "read_p50": int(lat_p[0]),
+        "read_p90": int(lat_p[1]),
+        "read_p99": int(lat_p[2]),
+        "mttr_rounds": (
+            round(healed / reelections, 3) if reelections else None
+        ),
+        "reelections": reelections,
+        "max_leaderless_streak": int(stats[CS_MAX_STREAK]),
+        "safety": {
+            name: int(v) for name, v in zip(SAFETY_NAMES, safety)
+        },
+    }

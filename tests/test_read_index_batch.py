@@ -198,6 +198,11 @@ def test_read_index_storm_learners():
         run_probe_schedule(seed, 3, 5, 60, voters=[1, 2, 3, 4], learners=[5])
 
 
+@pytest.mark.slow  # ~12s: ISSUE 13 paid its tier-1 additions with this
+# one (tools/tier1_budget.py top-N) — the mixed joint/learner Safe-read
+# shape is now ALSO covered tier-1 by the in-step read path's replay
+# parity (tests/test_workload.py) and in the slow tier by
+# tests/test_read_lease.py's config fuzz matrix.
 def test_read_index_storm_mixed():
     for seed in (103, 211):
         run_probe_schedule(

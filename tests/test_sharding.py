@@ -74,3 +74,46 @@ def test_sharded_read_index_matches_local():
     got = np.asarray(fn(st_sh, jax.device_put(
         crashed, NamedSharding(mesh, P(None, "groups")))))
     np.testing.assert_array_equal(want, got)
+
+
+def test_client_schedule_and_carry_shard_on_groups():
+    """The workload schedule + read carry shard on G (ISSUE 13): specs
+    place every [.., G] plane (incl. the PACKED fire words — the word
+    axis IS the group axis / 32) on the groups mesh axis, round-indexed
+    and accumulator arrays replicated, and a placed schedule feeds the
+    workload scan unchanged."""
+    from raft_tpu.multiraft import workload
+
+    G = 256  # 8 packed words: the fire plane tiles the 8-device mesh
+    plan = workload.ClientPlan(
+        name="shard",
+        n_peers=3,
+        phases=[
+            workload.ClientPhase(rounds=8, append=1),
+            workload.ClientPhase(rounds=8, read_every=2,
+                                 read_mode="lease"),
+        ],
+    )
+    compiled = workload.compile_plan(plan, G)
+    rcar = workload.init_read_carry(G)
+    mesh = sharding.make_mesh()
+    placed_sched, placed_rcar = sharding.shard_client(
+        compiled, rcar, mesh
+    )
+    assert placed_sched.read_fire_packed.sharding.spec == P(None, "groups")
+    assert placed_sched.read_mode.sharding.spec == P(None, "groups")
+    assert placed_sched.append.sharding.spec == P(None, "groups")
+    assert placed_sched.phase_of_round.sharding.spec == P()
+    assert placed_rcar.pending_mode.sharding.spec == P("groups",)
+    # Bit-identical contents after placement.
+    np.testing.assert_array_equal(
+        np.asarray(placed_sched.read_fire_packed),
+        np.asarray(compiled.read_fire_packed),
+    )
+    # A width that does NOT tile the mesh replicates the fire words
+    # instead of failing (read-only schedule data).
+    small = workload.compile_plan(plan, 32)  # 1 packed word
+    placed_small, _ = sharding.shard_client(
+        small, workload.init_read_carry(32), mesh
+    )
+    assert placed_small.read_fire_packed.sharding.spec == P()

@@ -49,13 +49,16 @@ def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
     program.analyze()
     violations.extend(program.violations)
 
-    # GC008: plane-overflow bounds over kernels.py + sim.py.
+    # GC008: plane-overflow bounds over kernels.py + sim.py + workload.py.
     kernels_sf = _module_file(files, "raft_tpu/multiraft/kernels.py")
     sim_sf = _module_file(files, "raft_tpu/multiraft/sim.py")
+    workload_sf = _module_file(files, "raft_tpu/multiraft/workload.py")
     if kernels_sf is not None:
         violations.extend(overflow.check_kernels(kernels_sf))
     if sim_sf is not None:
         violations.extend(overflow.check_sim(sim_sf))
+    if workload_sf is not None:
+        violations.extend(overflow.check_workload(workload_sf))
 
     # GC009: traced escape across call boundaries.
     violations.extend(check_traced_escape(files, ctx))

@@ -139,6 +139,27 @@ TRANSFER_PLANES: Dict[str, tuple] = {
     ),
 }
 
+# Read planes (ISSUE 13): the client-workload runner's int32 accumulators
+# and carry (raft_tpu/multiraft/workload.py), registered like the counter
+# planes so a new slot ships with a derived bound
+# (docs/STATIC_ANALYSIS.md "Read planes").  Every RS_* stat slot and
+# every latency-histogram bucket grows by at most G per round, and
+# workload.compile_plan asserts rounds x G < 2**31 at compile time — the
+# chaos/reconfig no-wrap argument verbatim.  The carry planes are not
+# accumulators: pending_mode holds sim.READ_* codes (<= 2) and
+# pending_since an absolute round index (< n_rounds < 2**31 by the same
+# compile bound).  Enforced by check_workload below: every RS_* constant
+# in workload.py must be registered, N_READ_STATS must equal the registry
+# size, and the compile-time wrap assert must survive.
+READ_PLANES: Dict[str, str] = {
+    "RS_ISSUED": "<= G fresh reads per round",
+    "RS_SERVED_LEASE": "<= G lease serves per round",
+    "RS_SERVED_QUORUM": "<= G quorum serves per round",
+    "RS_DEGRADED_SERVES": "<= G degraded serves per round",
+    "RS_RETRY_ROUNDS": "<= G outstanding (group, round) pairs per round",
+    "RS_DROPPED_FIRES": "<= G dropped fires per round",
+}
+
 
 def _v(sf: SourceFile, lineno: int, message: str) -> Violation:
     return Violation(sf.display_path, lineno, GC008, GC008_SLUG, message)
@@ -350,6 +371,100 @@ def _increment_bound(
     if isinstance(node, ast.Name) and node.id in DECLARED_BOUNDED:
         return DECLARED_BOUNDED[node.id]
     return None
+
+
+# --- workload.py side -------------------------------------------------------
+
+
+def check_workload(sf: SourceFile) -> Iterator[Violation]:
+    """READ_PLANES enforcement over workload.py: every RS_* stat slot is
+    registered with a derived bound, the N_READ_STATS total agrees, and
+    the rounds x G < 2**31 compile-time wrap assert survives in
+    `_compile_arrays` (the whole no-wrap argument rests on it)."""
+    seen_rs: Dict[str, int] = {}
+    n_stats: Optional[int] = None
+    compile_fn: Optional[ast.FunctionDef] = None
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ):
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id.startswith("RS_"):
+                    seen_rs[t.id] = node.lineno
+                elif t.id == "N_READ_STATS" and isinstance(
+                    node.value.value, int
+                ):
+                    n_stats = node.value.value
+        elif (
+            isinstance(node, ast.FunctionDef)
+            and node.name == "_compile_arrays"
+        ):
+            compile_fn = node
+    for name, lineno in seen_rs.items():
+        if name not in READ_PLANES:
+            yield _v(
+                sf,
+                lineno,
+                f"read-stats slot {name} is not in the GC008 READ_PLANES "
+                "registry (tools/graftcheck/engine/overflow.py); derive "
+                "its per-round growth bound and register it "
+                "(docs/STATIC_ANALYSIS.md)",
+            )
+    for name in READ_PLANES:
+        if name not in seen_rs:
+            yield _v(
+                sf,
+                1,
+                f"READ_PLANES registers {name} but workload.py defines no "
+                "such slot; the registered bound is orphaned",
+            )
+    if n_stats is not None and n_stats != len(READ_PLANES):
+        yield _v(
+            sf,
+            1,
+            f"N_READ_STATS == {n_stats} but the READ_PLANES registry has "
+            f"{len(READ_PLANES)} slots; register the new slot with its "
+            "bound before shipping it",
+        )
+    def _is_two_pow_31(node: ast.expr) -> bool:
+        return (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Pow)
+            and isinstance(node.left, ast.Constant)
+            and node.left.value == 2
+            and isinstance(node.right, ast.Constant)
+            and node.right.value == 31
+        )
+
+    # The guard must be STRUCTURAL: an `if <...> >= 2**31` (or `< 2**31`
+    # inverted) whose body raises — a comparison that no longer guards a
+    # raise is exactly the silent removal this check exists to catch.
+    has_wrap_assert = False
+    if compile_fn is not None:
+        for n in ast.walk(compile_fn):
+            if not isinstance(n, ast.If):
+                continue
+            test = n.test
+            compares_bound = isinstance(test, ast.Compare) and (
+                any(_is_two_pow_31(c) for c in test.comparators)
+                or _is_two_pow_31(test.left)
+            )
+            raises = any(
+                isinstance(b, ast.Raise) for b in ast.walk(n)
+            )
+            if compares_bound and raises:
+                has_wrap_assert = True
+                break
+    if not has_wrap_assert:
+        yield _v(
+            sf,
+            compile_fn.lineno if compile_fn is not None else 1,
+            "workload._compile_arrays no longer bounds rounds x G < 2**31;"
+            " the int32 read-stats/latency accumulators lose their "
+            "no-wrap argument (docs/STATIC_ANALYSIS.md)",
+        )
 
 
 # --- sim.py side ------------------------------------------------------------

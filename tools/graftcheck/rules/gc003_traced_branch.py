@@ -51,6 +51,7 @@ _STATIC_CONFIG_FIELDS = {
     "check_quorum",
     "pre_vote",
     "transfer",
+    "lease_read",
     "min_timeout",
     "max_timeout",
 }

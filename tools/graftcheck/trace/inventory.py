@@ -417,6 +417,116 @@ def _autopilot_runner_builder():
     return build
 
 
+def _read_step_builder():
+    def build() -> Built:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True,
+            check_quorum=True, lease_read=True,
+        )
+        st, crashed, append_n = _base_args(cfg)
+        fn = jax.jit(functools.partial(sim.step, cfg))
+        # Positional tail: (group_ids, counters, health, link,
+        # reconfig_propose, transfer_propose, campaign_kick,
+        # read_propose) — the damped round with the client-read phase
+        # live (lease gate + nudge-cutoff ReadIndex fallback).
+        args = (
+            st, crashed, append_n, None, None, sim.init_health(cfg),
+            jnp.ones((P, P, G), bool), None, None, None,
+            jnp.full((G,), sim.READ_LEASE, jnp.int32),
+        )
+        return Built(fn, args)
+
+    return build
+
+
+def _client_plan():
+    from raft_tpu.multiraft import workload
+
+    return workload.ClientPlan(
+        name="graftcheck-inventory",
+        n_peers=P,
+        phases=[
+            workload.ClientPhase(rounds=SCAN_ROUNDS, append=1),
+            workload.ClientPhase(
+                rounds=SCAN_ROUNDS, read_every=2, read_mode="lease",
+                write_zipf=1.8,
+            ),
+            workload.ClientPhase(
+                rounds=SCAN_ROUNDS, read_every=2, read_mode="safe"
+            ),
+        ],
+    )
+
+
+def _workload_runner_builder():
+    def build() -> Built:
+        from raft_tpu.multiraft import reconfig, workload
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True,
+            check_quorum=True, lease_read=True,
+        )
+        compiled = workload.compile_plan(_client_plan(), G)
+        runner = workload.make_runner(cfg, compiled)
+        st, _, _ = _base_args(cfg)
+        return Built(
+            runner.jitted,
+            (
+                st, sim.init_health(cfg),
+                reconfig.init_reconfig_state(st),
+                workload.init_read_carry(G),
+            ) + runner.schedule_args,
+            (0, 1, 2, 3),
+        )
+
+    return build
+
+
+def _workload_split_builder():
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.multiraft import chaos, kernels, reconfig, workload
+
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True,
+            check_quorum=True, lease_read=True,
+        )
+        compiled = workload.compile_plan(_client_plan(), G)
+        runner = workload.make_split_runner(
+            cfg, compiled, k=DISPATCH_K,
+            interpret=jax.default_backend() != "tpu",
+        )
+        st, _, _ = _base_args(cfg)
+        # The fused-block jit is the split runner's hot graph: the
+        # steady/read-pending/lease-provable predicate, the fused damped
+        # kernel with the closed-form receipt fold, AND the k-round
+        # general fallback (full read machinery) under one cond.
+        args = (
+            st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
+            jnp.zeros((chaos.N_CHAOS_STATS,), jnp.int32),
+            jnp.zeros((reconfig.N_RECONFIG_STATS,), jnp.int32),
+            jnp.zeros((kernels.N_SAFETY,), jnp.int32),
+            workload.init_read_carry(G),
+            jnp.zeros((workload.N_READ_STATS,), jnp.int32),
+            jnp.zeros((workload.N_LAT_BUCKETS,), jnp.int32),
+            jnp.int32(0),
+            jnp.int32(0),
+        ) + runner.schedule_args
+        return Built(runner.fused_jit, args, (0, 1, 2, 6))
+
+    return build
+
+
 def _sharded_builder(kind: str):
     def build() -> Built:
         import jax
@@ -535,6 +645,39 @@ def _specs() -> List[GraphSpec]:
         GraphSpec(
             name="read_index@chaos", anchor=sim_py,
             build=_read_index_builder(True),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The read-enabled damped round (ISSUE 13): the client-read
+            # phase (lease gate + nudge-cutoff ReadIndex fallback) live
+            # via read_propose; the read-OFF graphs are the bit-identical
+            # step@* rows above (the pinned-unchanged claim).
+            name="step@health+reads+cq",
+            anchor=sim_py,
+            build=_read_step_builder(),
+            audit_donation=False,
+        )
+    )
+    workload_py = "raft_tpu/multiraft/workload.py"
+    out.append(
+        GraphSpec(
+            # The ISSUE 13 compiled client-workload scan: state + health
+            # + op carry + read carry all donated; schedule arrays are
+            # runtime args (the GC012 lesson, applied from birth).
+            name="workload_runner@health+reads+cq",
+            anchor=workload_py,
+            build=_workload_runner_builder(),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The split-fused read block: fused damped kernel +
+            # closed-form lease receipts + the general fallback under one
+            # cond, carry donated end to end.
+            name=f"workload_split{DISPATCH_K}@health+reads+cq",
+            anchor=workload_py,
+            build=_workload_split_builder(),
         )
     )
     pallas_py = "raft_tpu/multiraft/pallas_step.py"
