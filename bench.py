@@ -152,6 +152,29 @@ replaces the steady bench:
                   linearizability slots included — exits 2.
   --reads-out F   also write the read report JSON to F (CI artifact).
 
+Multi-chip mode (ISSUE 14; docs/PERF.md "Multi-chip") replaces the
+steady bench with BASELINE config 5 on the mesh:
+
+  --mesh N        shard the fleet over an N-device mesh
+                  (sharding.make_mesh) and run the group axis scaled out:
+                  groups x 3 peers bootstrapped from the leader-election
+                  storm DIRECTLY onto the mesh (no global [P, P, G] plane
+                  ever materializes on one host), advanced as the donated
+                  run_compiled scan under jit-with-shardings — the graph
+                  graftcheck GC015 proves collective-free.  The JSON line
+                  carries total AND per-chip ticks/sec plus the analytic
+                  per-chip HBM plane-bytes table (the [P, P, G] pairwise
+                  planes broken out; the damped recent_active plane
+                  reported packed vs unpacked), under the
+                  `raft_ticks_per_sec_1m_groups_3_peers_sharded` metric
+                  key (`_cq_sharded` with --check-quorum: the damped
+                  fleet with the bits_g packed carry riding the sharded
+                  scan).  On a CPU host run with JAX_PLATFORMS=cpu so the
+                  virtual device mesh engages (numbers from such a run
+                  are NOT comparable to TPU medians; the CI artifact runs
+                  use --mesh 8 --groups 4096).  The config-5 headline run
+                  is `--mesh 8 --groups 1000000 --reps 3`.
+
 Baseline entries carrying `"retired": true` (e.g. the pre-fusion
 wave-replay `_cq` series) are historical anchors: --check skips them
 with a `retired-baseline` notice instead of gating on them, and
@@ -944,6 +967,128 @@ def bench_reads(
     }
 
 
+MESH_PEERS = 3  # BASELINE.json config 5: 1M groups x 3 peers
+MESH_ROUNDS_PER_SCAN = 64
+MESH_SCANS = 6
+
+
+def mesh_plane_bytes(cfg, n_devices: int) -> dict:
+    """Analytic per-chip HBM bytes of the sharded fleet state (ISSUE 14).
+
+    The [P, P, G] pairwise planes are where the cost is, so they are
+    broken out per plane; the damped recent_active plane reports BOTH its
+    unpacked bool[P, P, G] bytes and its bits_g packed scan-carry form
+    (kernels.pack_bits_g: 32 group-bits per int32 word — 8x fewer bytes
+    than XLA's byte-per-bool plane, 32x fewer carried elements).  Every
+    figure is per chip: the group axis divides across the mesh, the peer
+    axes stay local."""
+    import math
+
+    Gs = math.ceil(cfg.n_groups / n_devices)  # groups per chip
+    Pn = cfg.n_peers
+    i32 = 4
+    damped = cfg.check_quorum or cfg.pre_vote
+    pairwise = {
+        "matched": Pn * Pn * Gs * i32,
+        "agree": Pn * Pn * Gs * i32,
+    }
+    if damped:
+        pairwise["recent_active_unpacked"] = Pn * Pn * Gs  # bool = 1 byte
+        pairwise["recent_active_packed"] = (
+            Pn * Pn * math.ceil(Gs / 32) * i32
+        )
+    # Per-peer planes: 11 int32 [P, G] cursors/timers + 3 bool config
+    # masks (+ the optional transferee plane).
+    per_peer = 11 * Pn * Gs * i32 + 3 * Pn * Gs
+    if cfg.transfer:
+        per_peer += Pn * Gs * i32
+    # The damped plane rides the scan carry PACKED, so the resident total
+    # counts the packed words, not the unpacked bool plane.
+    resident_pairwise = (
+        pairwise["matched"]
+        + pairwise["agree"]
+        + pairwise.get("recent_active_packed", 0)
+    )
+    return {
+        "groups_per_chip": Gs,
+        "pairwise": pairwise,
+        "per_peer_total": per_peer,
+        "total_per_chip": resident_pairwise + per_peer,
+    }
+
+
+def bench_mesh(
+    groups: int,
+    n_devices: int,
+    reps: int = REPS,
+    check_quorum: bool = False,
+) -> dict:
+    """BASELINE config 5 on the mesh (ISSUE 14): groups x 3 peers
+    bootstrapped from the leader-election storm (init_state's randomized
+    election clocks), sharded over `n_devices` chips, advanced as the
+    donated run_compiled lax.scan under jit-with-shardings — the
+    steady graph graftcheck GC015 proves collective-free.  The
+    bootstrap never materializes a global [P, P, G] plane on one host
+    (sharding.sharded_init_state).  Reports total AND per-chip
+    ticks/sec plus the analytic per-chip plane-bytes table."""
+    from raft_tpu.multiraft import sharding, sim
+    from raft_tpu.multiraft.sim import SimConfig
+
+    if len(jax.devices()) < n_devices:
+        print(
+            f"ERROR: --mesh {n_devices} needs {n_devices} devices but jax "
+            f"sees {len(jax.devices())} — on a CPU host run with "
+            "JAX_PLATFORMS=cpu so the virtual device mesh engages",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    mesh = sharding.make_mesh(n_devices)
+    cfg = SimConfig(
+        n_groups=groups, n_peers=MESH_PEERS,
+        election_tick=64 if check_quorum else 10,
+        check_quorum=check_quorum, pre_vote=check_quorum,
+    )
+    cs = sim.ClusterSim(cfg, mesh=mesh)
+    append = cs._put(jnp.ones((groups,), jnp.int32), True)
+
+    # Settle the election storm (config 5's initial condition), then one
+    # warm segment so the timed region replays a compiled executable.
+    settle = 30 if not check_quorum else 3 * cfg.election_tick
+    cs.run_compiled(settle, append_n=append)
+    cs.run_compiled(MESH_ROUNDS_PER_SCAN, append_n=append)
+    jax.block_until_ready(cs.state.term)
+
+    rounds = MESH_ROUNDS_PER_SCAN * MESH_SCANS
+    ticks = groups * rounds
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(MESH_SCANS):
+            cs.run_compiled(MESH_ROUNDS_PER_SCAN, append_n=append)
+        jax.block_until_ready(cs.state.term)
+        samples.append(ticks / (time.perf_counter() - t0))
+
+    # Sanity: the protocol is running on every shard (post-storm leaders
+    # committing) — via the ICI status reduction, exact total_commit
+    # included (the ISSUE 14 limb fix: 1M groups x thousands of commits
+    # would wrap the old single int32 psum).
+    status = sharding.global_status(cs.cfg, mesh)(cs.state)
+    assert int(status["n_leaders"]) > 0, "mesh bench sanity: no leaders"
+    assert status["total_commit"] > 0, "mesh bench sanity: no commits"
+    stats = rep_stats(samples)
+    per_chip = {
+        k: round(stats[k] / n_devices, 1) for k in ("min", "median", "max")
+    }
+    return {
+        **stats,
+        "n_devices": n_devices,
+        "per_chip_ticks_per_sec": per_chip,
+        "per_chip_plane_bytes": mesh_plane_bytes(cfg, n_devices),
+        "n_leaders": int(status["n_leaders"]),
+        "total_commit": status["total_commit"],
+    }
+
+
 def bench_scalar_anchor(reps: int = REPS) -> dict:
     from raft_tpu.multiraft.native import NativeMultiRaft
 
@@ -1110,6 +1255,7 @@ def main() -> None:
     ap.add_argument("--autopilot-out", default="", metavar="FILE")
     ap.add_argument("--reads", default="", metavar="PLAN_JSON")
     ap.add_argument("--reads-out", default="", metavar="FILE")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N_DEVICES")
     ap.add_argument("--cadence", type=int, default=16)
     ap.add_argument("--split-k", type=int, default=8)
     ap.add_argument("--split-window", type=int, default=4)
@@ -1165,6 +1311,47 @@ def main() -> None:
                  "file's \"chaos\" key)")
     if args.reads_out and not args.reads:
         ap.error("--reads-out requires --reads")
+    if args.mesh and (
+        args.chaos or args.reconfig or args.prod_fused or args.autopilot
+        or args.reads or args.health or args.lossy >= 0.0
+    ):
+        ap.error("--mesh is its own mode (the sharded config-5 bench; "
+                 "--check-quorum composes for the damped/packed-carry "
+                 "variant)")
+    if args.mesh < 0:
+        ap.error("--mesh needs a positive device count")
+
+    if args.mesh:
+        import os
+
+        # The virtual CPU mesh needs its device count pinned BEFORE the
+        # backend initializes; only force when the process explicitly
+        # targets CPU (JAX_PLATFORMS=cpu — the CI/dryrun setting), so a
+        # real TPU mesh keeps its devices.
+        if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+            from raft_tpu.platform import force_virtual_cpu
+
+            force_virtual_cpu(args.mesh)
+        mesh_stats = bench_mesh(
+            args.groups, args.mesh, args.reps,
+            check_quorum=args.check_quorum,
+        )
+        warn_spread("mesh device", mesh_stats)
+        line = {
+            "metric": "raft_ticks_per_sec_1m_groups_3_peers"
+            + ("_cq" if args.check_quorum else "")
+            + "_sharded",
+            "value": mesh_stats["median"],
+            "unit": "ticks/sec",
+            "groups": args.groups,
+            **mesh_stats,
+        }
+        if args.check_quorum:
+            line["check_quorum"] = True
+        print(json.dumps(line))
+        if args.check:
+            run_check(args, line)
+        return
 
     if args.reads:
         read_stats = bench_reads(

@@ -3,9 +3,25 @@
 The MultiRaft batch is embarrassingly parallel across groups — every [G, P]
 plane shards on G ('groups' mesh axis), the peer axis stays local to a chip
 (P <= 8; a group's whole quorum computation is a few lanes of one VPU
-register).  XLA therefore inserts NO collectives in the steady-state step;
-the only cross-chip traffic is the status reduction (leader counts, commit
-mins) which rides ICI via psum/pmin inside shard_map.
+register).  XLA therefore inserts NO collectives in the steady-state step
+graph — a claim that is machine-checked, not assumed, since ISSUE 14: the
+graftcheck GC015 collective audit compiles the sharded step/scan rows of
+the trace inventory over a multi-device mesh and fails the build on ANY
+collective op in them (SimConfig.spmd replaces the one offender, the
+election-phase cond's global-any predicate, with its bit-identical masked
+form).  The only cross-chip traffic is the status/drain reductions (leader
+counts, commit mins, health summaries), which ride ICI via psum/pmin
+inside shard_map — exactly the reduction set registered in the GC015
+allow-registry (tools/graftcheck/trace/inventory.py COLLECTIVE_ALLOW).
+
+The production mesh path is `ClusterSim(cfg, mesh=...)` (ISSUE 14): the
+bootstrap builds each shard device-resident (sharded_init_state — the
+global [P, P, G] planes never materialize on one host), every run_*
+entry point places its schedule arrays with the *_sharding specs below,
+and the donated run_compiled scan segments, the split-fused runners, and
+the drain/scan overlap all execute under jit-with-shardings unchanged —
+bit-identical to the single-device path on the golden chaos and reconfig
+corpora (tests/test_sharded_parity.py, tools/sharded_parity_report.py).
 
 This is the direct analog of data parallelism for consensus (SURVEY.md §2
 parallelism checklist item (a)); peer-axis vectorization is item (b); the
@@ -71,6 +87,95 @@ def shard_state(state: SimState, mesh: Mesh, axis: str = "groups") -> SimState:
     return jax.tree.map(jax.device_put, state, shardings)
 
 
+def sharded_init_state(
+    cfg: SimConfig,
+    mesh: Mesh,
+    voter_mask=None,
+    outgoing_mask=None,
+    learner_mask=None,
+    axis: str = "groups",
+) -> SimState:
+    """Bootstrap a fleet DIRECTLY onto the mesh: init_state under jit with
+    out_shardings, so every plane — including the [P, P, G] pairwise
+    matched/agree/recent_active planes, the HBM cost at production G —
+    materializes as per-chip shards and the global arrays never exist on
+    one host (the ISSUE 14 1M-group bootstrap requirement).  The iota node
+    keys stay GLOBAL group ids (jit sees the global shapes), so the
+    per-(group, term) timeout PRNG draws exactly the single-device
+    streams.  Optional config masks are small [P, G] host arrays; None
+    keeps init_state's uniform bootstrap."""
+    shardings = state_sharding(
+        mesh, axis, damped=cfg.check_quorum or cfg.pre_vote,
+        transfer=cfg.transfer,
+    )
+    mask_sh = NamedSharding(mesh, P(None, axis))
+
+    init = jax.jit(
+        functools.partial(sim.init_state, cfg),
+        in_shardings=(mask_sh, mask_sh, mask_sh),
+        out_shardings=shardings,
+    )
+    G, Pn = cfg.n_groups, cfg.n_peers
+    if voter_mask is None:
+        voter_mask = jnp.ones((Pn, G), bool)
+    if outgoing_mask is None:
+        outgoing_mask = jnp.zeros((Pn, G), bool)
+    if learner_mask is None:
+        learner_mask = jnp.zeros((Pn, G), bool)
+    return init(voter_mask, outgoing_mask, learner_mask)
+
+
+def health_sharding(mesh: Mesh, axis: str = "groups"):
+    """NamedShardings for the HealthState pytree: the [H, G] planes shard
+    on the group axis, the scalar churn-window cursor is replicated."""
+    from .sim import HealthState
+
+    return HealthState(
+        planes=NamedSharding(mesh, P(None, axis)),
+        window_pos=NamedSharding(mesh, P()),
+    )
+
+
+def shard_health(health, mesh: Mesh, axis: str = "groups"):
+    """Place a HealthState on the mesh (device_put mirror of shard_state)."""
+    return jax.tree.map(jax.device_put, health, health_sharding(mesh, axis))
+
+
+def chaos_sharding(mesh: Mesh, axis: str = "groups"):
+    """NamedShardings for a compiled chaos schedule (chaos.CompiledChaos):
+    every packed per-phase plane is group-minor ([NPH, W, G] — the packed
+    word axis covers the P*P link pairs, NOT groups, so the planes shard
+    cleanly on their last axis), the per-phase append workload is
+    [NPH, G], and the round-indexed phase_of_round is replicated
+    (group-free).  Per-link loss draws are keyed by GLOBAL (round, src,
+    dst, group) counters computed from the global iota under
+    jit-with-shardings, so the sharded replay is bit-identical."""
+    from .chaos import CompiledChaos
+
+    rep = NamedSharding(mesh, P())
+    xg = NamedSharding(mesh, P(None, axis))
+    xxg = NamedSharding(mesh, P(None, None, axis))
+    return CompiledChaos(
+        phase_of_round=rep, link_packed=xxg, loss_packed=xxg,
+        crashed_packed=xxg, append=xg, n_peers=None,
+    )
+
+
+def shard_chaos(compiled, mesh: Mesh, axis: str = "groups"):
+    """Place a compiled chaos schedule on the mesh (the device_put mirror
+    of shard_state for the fault-injection arrays)."""
+    sched_sh = chaos_sharding(mesh, axis)
+    return compiled._replace(
+        **{
+            name: jax.device_put(
+                getattr(compiled, name), getattr(sched_sh, name)
+            )
+            for name in compiled._fields
+            if name != "n_peers"
+        }
+    )
+
+
 def sharded_step(
     cfg: SimConfig, mesh: Mesh, axis: str = "groups", donate: bool = True
 ):
@@ -99,16 +204,34 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
     """MultiRaftStatus reduction (SURVEY.md §5.5): per-shard partial
     aggregates combined across chips with XLA collectives over ICI.
 
-    Returns a jitted fn: SimState -> dict of scalars
-      n_leaders:   groups currently led
-      min_commit:  minimum commit index across groups
-      max_term:    maximum term across groups
-      total_commit: sum of per-group leader commit indices
-    """
+    Returns a callable: SimState -> dict
+      n_leaders:   groups currently led (device scalar)
+      min_commit:  minimum commit index across groups (device scalar)
+      max_term:    maximum term across groups (device scalar)
+      total_commit: sum of per-group leader commit indices — an EXACT
+                   host python int (see below)
+
+    total_commit overflow (ISSUE 14): with x64 off the old single int32
+    psum wrapped at ~1M groups x commit > 2k.  The device side now psums
+    FOUR int32 limb sums — each group's leader commit split into its 8-bit
+    bytes, so limb i's global sum is bounded by n_groups * 255 < 2**31 for
+    any fleet under ~8.4M groups (asserted at build) — and the host
+    recombines them in unbounded python ints: total = sum(limb_i << 8*i).
+    The recombination is the only host-side arithmetic; the reduction
+    itself stays on ICI.  The underlying jitted fn is exposed as `.jitted`
+    for the graftcheck trace audit (GC015 pins this graph's collective
+    set to exactly its psum/pmin reductions)."""
     try:
         from jax import shard_map
     except ImportError:  # jax < 0.5 keeps shard_map under experimental
         from jax.experimental.shard_map import shard_map
+
+    if cfg.n_groups * 255 >= 2**31:
+        raise ValueError(
+            f"global_status limb sums can wrap int32 at n_groups="
+            f"{cfg.n_groups} (needs n_groups * 255 < 2**31, ~8.4M groups);"
+            " widen the limb split to 4-bit nibbles for larger fleets"
+        )
 
     state_specs = jax.tree.map(
         lambda s: s.spec,
@@ -124,19 +247,28 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
         lead_commit = jnp.max(jnp.where(is_leader, st.commit, 0), axis=0)
         group_commit = jnp.max(st.commit, axis=0)
         n_leaders = jax.lax.psum(
-            jnp.sum(has_leader.astype(jnp.int32)), axis_name=axis
+            jnp.sum(has_leader.astype(jnp.int32), dtype=jnp.int32),
+            axis_name=axis,
         )
         min_commit = jax.lax.pmin(jnp.min(group_commit), axis_name=axis)
         max_term = jax.lax.pmax(jnp.max(st.term), axis_name=axis)
-        total_commit = jax.lax.psum(
-            jnp.sum(lead_commit.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)),
-            axis_name=axis,
+        # 8-bit limb decomposition of each nonneg int32 commit: limb 3 is
+        # the sign-free top 7 bits, so every limb value is <= 255 and the
+        # global limb sum is provably < 2**31 (the build-time assert).
+        limbs = jnp.stack(
+            [
+                jnp.sum(
+                    (lead_commit >> (8 * i)) & 0xFF, dtype=jnp.int32
+                )
+                for i in range(4)
+            ]
         )
+        total_commit_limbs = jax.lax.psum(limbs, axis_name=axis)
         return {
             "n_leaders": n_leaders,
             "min_commit": min_commit,
             "max_term": max_term,
-            "total_commit": total_commit,
+            "total_commit_limbs": total_commit_limbs,
         }
 
     fn = shard_map(
@@ -147,10 +279,24 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
             "n_leaders": P(),
             "min_commit": P(),
             "max_term": P(),
-            "total_commit": P(),
+            "total_commit_limbs": P(),
         },
     )
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+
+    def status(st: SimState) -> dict:
+        out = dict(jitted(st))
+        # graftcheck: allow-no-host-sync-in-jit — the fixed-size [4] limb
+        # download happens HERE, outside the jitted reduction, exactly
+        # like the health-summary drain.
+        limb_vals = jax.device_get(out.pop("total_commit_limbs"))
+        out["total_commit"] = sum(
+            int(v) << (8 * i) for i, v in enumerate(limb_vals)
+        )
+        return out
+
+    status.jitted = jitted  # type: ignore[attr-defined]
+    return status
 
 
 def sharded_read_index(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
@@ -199,7 +345,9 @@ def reconfig_sharding(mesh: Mesh, axis: str = "groups"):
 
 def shard_reconfig(compiled, rstate, mesh: Mesh, axis: str = "groups"):
     """Place a compiled reconfig schedule + carry on the mesh (the
-    device_put mirror of shard_state for the reconfig arrays)."""
+    device_put mirror of shard_state for the reconfig arrays).  `rstate`
+    may be None (schedule-only placement: ClusterSim(mesh=) derives the
+    op-protocol carry from the already-sharded state each run)."""
     sched_sh, rstate_sh = reconfig_sharding(mesh, axis)
     placed_sched = compiled._replace(
         **{
@@ -210,7 +358,11 @@ def shard_reconfig(compiled, rstate, mesh: Mesh, axis: str = "groups"):
             if name != "n_peers"
         }
     )
-    placed_rstate = jax.tree.map(jax.device_put, rstate, rstate_sh)
+    placed_rstate = (
+        None
+        if rstate is None
+        else jax.tree.map(jax.device_put, rstate, rstate_sh)
+    )
     return placed_sched, placed_rstate
 
 
@@ -244,7 +396,8 @@ def client_sharding(mesh: Mesh, axis: str = "groups"):
 
 def shard_client(compiled, rcar, mesh: Mesh, axis: str = "groups"):
     """Place a compiled client schedule + read carry on the mesh (the
-    device_put mirror of shard_state for the workload arrays).
+    device_put mirror of shard_state for the workload arrays).  `rcar`
+    may be None (schedule-only placement, like shard_reconfig's).
 
     The packed fire plane's word axis is the group axis / 32, so it
     shards only when the word count tiles the mesh (ceil(G/32) divisible
@@ -264,7 +417,11 @@ def shard_client(compiled, rcar, mesh: Mesh, axis: str = "groups"):
             if name != "n_peers"
         }
     )
-    placed_rcar = jax.tree.map(jax.device_put, rcar, rcar_sh)
+    placed_rcar = (
+        None
+        if rcar is None
+        else jax.tree.map(jax.device_put, rcar, rcar_sh)
+    )
     return placed_sched, placed_rcar
 
 
@@ -275,17 +432,19 @@ def run_sharded(
     axis: str = "groups",
 ) -> Tuple[SimState, dict]:
     """Initialize, shard, and advance `rounds` steps on the mesh; returns
-    (final_state, global status dict)."""
-    st = shard_state(sim.init_state(cfg), mesh, axis)
-    step_fn = sharded_step(cfg, mesh, axis)
-    crashed = jax.device_put(
-        jnp.zeros((cfg.n_peers, cfg.n_groups), bool),
-        NamedSharding(mesh, P(None, axis)),
-    )
+    (final_state, global status dict).
+
+    Thin compat wrapper (ISSUE 14): the per-round host dispatch loop this
+    function used to run is retired — the rounds now execute as ONE
+    donated lax.scan under jit-with-shardings through
+    ClusterSim(mesh=).run_compiled, the same fast path every other mesh
+    entry point uses (zero per-round host dispatches, double-buffered
+    carry, SPMD-friendly graphs).  Signature and results are unchanged;
+    the MULTICHIP smoke keeps passing against the scan path."""
+    cs = sim.ClusterSim(cfg, mesh=mesh, mesh_axis=axis)
     append = jax.device_put(
         jnp.ones((cfg.n_groups,), jnp.int32), NamedSharding(mesh, P(axis))
     )
-    for _ in range(rounds):
-        st = step_fn(st, crashed, append)
-    status = global_status(cfg, mesh, axis)(st)
-    return st, jax.tree.map(lambda x: int(x), status)
+    cs.run_compiled(rounds, append_n=append)
+    status = global_status(cs.cfg, mesh, axis)(cs.state)
+    return cs.state, jax.tree.map(lambda x: int(x), status)

@@ -170,6 +170,20 @@ class SimConfig(NamedTuple):
     # bit-identical regardless, and no new SimState plane exists (the
     # lease gate reads the ISSUE 7 planes).
     lease_read: bool = False
+    # SPMD/mesh-friendly graphs (ISSUE 14): when True, the plain step runs
+    # its election phase UNCONDITIONALLY as masked ops instead of behind
+    # `lax.cond(jnp.any(want_campaign & alive))`.  The cond's scalar
+    # predicate is a global reduction over the group axis, which the GSPMD
+    # partitioner must lower as a per-round cross-chip all-reduce — the
+    # one collective the otherwise embarrassingly-parallel steady step
+    # graph would carry on a device mesh (machine-checked by graftcheck
+    # GC015).  The election phase is a provable no-op when nobody
+    # campaigned (every write is masked on this round's campaigners), so
+    # the two forms are bit-identical — pinned by
+    # tests/test_sharded_parity.py.  Off by default: single-chip graphs
+    # keep the data-dependent skip (and their pinned jaxprs);
+    # ClusterSim(mesh=) enables it automatically.
+    spmd: bool = False
 
     @property
     def min_timeout(self) -> int:
@@ -1393,20 +1407,34 @@ def step(
             commit, jnp.zeros((G,), bool),
         )
 
-    (
+    _election_args = (
         term, state, vote, leader_id, ee, hb, rt,
-        new_last_index, new_last_term, matched, term_start, commit_c,
-        winner_exists,
-    ) = jax.lax.cond(
-        jnp.any(req),
-        election,
-        no_election,
+        st.last_index, st.last_term, st.matched, st.term_start_index,
+        st.commit,
+    )
+    if cfg.spmd:
+        # Mesh-friendly form (ISSUE 14): the cond's `jnp.any(req)`
+        # predicate is a global reduction — a per-round cross-chip
+        # all-reduce under GSPMD — so the SPMD graph runs the election
+        # phase unconditionally; every write inside is masked on `req`,
+        # making the no-campaigner round a bit-exact no-op (pinned by
+        # tests/test_sharded_parity.py, audited by GC015).
         (
             term, state, vote, leader_id, ee, hb, rt,
-            st.last_index, st.last_term, st.matched, st.term_start_index,
-            st.commit,
-        ),
-    )
+            new_last_index, new_last_term, matched, term_start, commit_c,
+            winner_exists,
+        ) = election(_election_args)
+    else:
+        (
+            term, state, vote, leader_id, ee, hb, rt,
+            new_last_index, new_last_term, matched, term_start, commit_c,
+            winner_exists,
+        ) = jax.lax.cond(
+            jnp.any(req),
+            election,
+            no_election,
+            _election_args,
+        )
 
     # ---- Phase C': a campaigner that is the sole voter of both config
     # halves wins its election LOCALLY — campaign, self-vote, quorum of 1,
@@ -3524,9 +3552,40 @@ class ClusterSim:
         learner_mask: Optional[jnp.ndarray] = None,
         health_monitor=None,
         chaos=None,
+        mesh=None,
+        mesh_axis: str = "groups",
     ):
+        # Multi-chip mode (ISSUE 14): with `mesh` (a 1-D jax.sharding.Mesh
+        # over the group axis — sharding.make_mesh), the fleet bootstraps
+        # DIRECTLY onto the mesh (sharding.sharded_init_state: the global
+        # [P, P, G] planes never materialize on one host), every run_*
+        # entry point places its per-round planes and compiled schedule
+        # arrays with the sharding.*_sharding specs, and the existing
+        # jitted runners — donated run_compiled segments, the chaos/
+        # reconfig/workload scans, the split-fused runners, the
+        # drain/scan overlap — execute under jit-with-shardings
+        # unchanged: XLA sees the global shapes, the iota node keys stay
+        # global, and every op partitions trivially along G.  The config
+        # is promoted to its SPMD-friendly graph form (SimConfig.spmd),
+        # which keeps the steady step graph collective-free on the mesh;
+        # results are bit-identical to the single-device path
+        # (tests/test_sharded_parity.py).
+        if mesh is not None and not cfg.spmd:
+            cfg = cfg._replace(spmd=True)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.cfg = cfg
-        self.state = init_state(cfg, voter_mask, outgoing_mask, learner_mask)
+        if mesh is None:
+            self.state = init_state(
+                cfg, voter_mask, outgoing_mask, learner_mask
+            )
+        else:
+            from . import sharding as sharding_mod
+
+            self.state = sharding_mod.sharded_init_state(
+                cfg, mesh, voter_mask, outgoing_mask, learner_mask,
+                axis=mesh_axis,
+            )
         self._step = jax.jit(functools.partial(step, cfg), donate_argnums=(0,))
         # Chaos engine attachment: a chaos.ChaosPlan or chaos.CompiledChaos
         # (plans compile lazily at this sim's batch shape).  run_plan()
@@ -3556,7 +3615,7 @@ class ClusterSim:
         self._rounds_since_drain = 0
         self._drain_every = self._DRAIN_MAX
         if cfg.collect_counters:
-            self._counters = kernels.zero_counters()
+            self._counters = self._put_replicated(kernels.zero_counters())
             # The device plane is int32 (TPUs have no native int64), so on
             # long runs it is periodically drained into this unbounded
             # host-side accumulator: one device_get every _drain_every
@@ -3581,6 +3640,12 @@ class ClusterSim:
             self._step_counted = jax.jit(_counted, donate_argnums=(0, 3))
         if cfg.collect_health:
             self._health = init_health(cfg)
+            if mesh is not None:
+                from . import sharding as sharding_mod
+
+                self._health = sharding_mod.shard_health(
+                    self._health, mesh, mesh_axis
+                )
             k = min(cfg.health_topk, cfg.n_groups)
 
             def _summarize(planes):
@@ -3611,6 +3676,36 @@ class ClusterSim:
 
     _DRAIN_MAX = 128  # never let a window exceed this many rounds
 
+    # --- mesh placement (ISSUE 14; no-ops off-mesh) ---
+
+    def _put(self, x, *spec_axes):
+        """Place `x` on the mesh with PartitionSpec(*spec_axes) — the
+        trailing axis name is this sim's group mesh axis where given as
+        True; None entries replicate that array axis.  Off-mesh (or for
+        None planes) this is the identity, so the single-device paths are
+        untouched.  device_put with an already-matching sharding is a
+        no-op, so repeated run_* calls don't copy."""
+        if self.mesh is None or x is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(
+            *(self.mesh_axis if a is True else None for a in spec_axes)
+        )
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    def _put_replicated(self, x):
+        return self._put(x)
+
+    def _put_round_planes(self, crashed, append_n, link=None):
+        """Place the constant per-round planes: crashed [P, G] and link
+        [P, P, G] shard on G, append_n [G] on its only axis."""
+        return (
+            self._put(crashed, None, True),
+            self._put(append_n, True),
+            self._put(link, None, None, True),
+        )
+
     def _begin_drain(self) -> dict:
         """Start a drain WITHOUT crossing to the host (ISSUE 11 drain/scan
         overlap): capture the counter plane — swapping fresh zeros in, so
@@ -3623,7 +3718,7 @@ class ClusterSim:
         bufs: dict = {}
         if self._counters is not None:
             bufs["counters"] = self._counters
-            self._counters = kernels.zero_counters()
+            self._counters = self._put_replicated(kernels.zero_counters())
         if self._health is not None and self.health_monitor is not None:
             bufs["summary"] = self._summary_fn(self._health.planes)
         self._rounds_since_drain = 0
@@ -3672,7 +3767,7 @@ class ClusterSim:
     def _drain_counters(self) -> None:
         """Blocking counter drain (run_round cadence / counters() reads)."""
         bufs = {"counters": self._counters}
-        self._counters = kernels.zero_counters()
+        self._counters = self._put_replicated(kernels.zero_counters())
         self._rounds_since_drain = 0
         self._settle_drain(bufs)
 
@@ -3694,6 +3789,9 @@ class ClusterSim:
             crashed = jnp.zeros((P, G), bool)
         if append_n is None:
             append_n = jnp.zeros((G,), jnp.int32)
+        crashed, append_n, link = self._put_round_planes(
+            crashed, append_n, link
+        )
         cc, ch = self._counters is not None, self._health is not None
         if cc and ch:
             self.state, self._counters, self._health = self._step_both(
@@ -3819,6 +3917,9 @@ class ClusterSim:
             crashed = jnp.zeros((P, G), bool)
         if append_n is None:
             append_n = jnp.zeros((G,), jnp.int32)
+        crashed, append_n, link = self._put_round_planes(
+            crashed, append_n, link
+        )
         cc = self._counters is not None
         ch = self._health is not None
         if cc:
@@ -3877,6 +3978,56 @@ class ClusterSim:
 
     # --- chaos engine (see raft_tpu/multiraft/chaos.py) ---
 
+    def _shard_chaos_schedule(self, compiled):
+        """Place a compiled chaos schedule on the mesh (identity
+        off-mesh); runs BEFORE make_runner so the runner's cached
+        schedule_args are the placed arrays."""
+        if self.mesh is None or compiled is None:
+            return compiled
+        from . import sharding as sharding_mod
+
+        return sharding_mod.shard_chaos(compiled, self.mesh, self.mesh_axis)
+
+    def _shard_reconfig_schedule(self, compiled):
+        """Place a compiled reconfig schedule on the mesh (identity
+        off-mesh); the op-protocol carry derives from the already-sharded
+        state each run, so only the schedule needs placing."""
+        if self.mesh is None or compiled is None:
+            return compiled
+        from . import sharding as sharding_mod
+
+        placed, _ = sharding_mod.shard_reconfig(
+            compiled, None, self.mesh, self.mesh_axis
+        )
+        return placed
+
+    def _place_reconfig_state(self, rst):
+        """Place a fresh op-protocol carry on the mesh (identity off-mesh):
+        the [G] protocol planes shard on the group axis, the prev-mask
+        copies keep the state's [P, G] spec."""
+        if self.mesh is None:
+            return rst
+        from . import sharding as sharding_mod
+
+        _, rstate_sh = sharding_mod.reconfig_sharding(
+            self.mesh, self.mesh_axis
+        )
+        return jax.tree.map(jax.device_put, rst, rstate_sh)
+
+    def _shard_client_schedule(self, compiled):
+        """Place a compiled client-workload schedule on the mesh (identity
+        off-mesh), including the packed read-fire words' tile-or-replicate
+        fallback (sharding.shard_client); the read carry is placed
+        separately per run (run_reads)."""
+        if self.mesh is None or compiled is None:
+            return compiled
+        from . import sharding as sharding_mod
+
+        placed, _ = sharding_mod.shard_client(
+            compiled, None, self.mesh, self.mesh_axis
+        )
+        return placed
+
     def _chaos_runner_for(self, plan=None):
         """(CompiledChaos, jitted runner) for `plan` (default: the attached
         one), cached so repeated run_plan() calls reuse one scan compile."""
@@ -3887,12 +4038,18 @@ class ClusterSim:
             raise RuntimeError(
                 "no chaos plan; construct with chaos= or pass one"
             )
-        if isinstance(plan, chaos_mod.CompiledChaos):
-            compiled = plan
-        elif plan is self._chaos and self._chaos_compiled is not None:
+        if plan is self._chaos and self._chaos_compiled is not None:
+            # The attached plan's lowered+PLACED schedule is cached
+            # (mesh placement must not defeat this cache: a fresh
+            # device_put namedtuple per call would invalidate the runner
+            # below and retrace the whole scan every run_plan).
             compiled = self._chaos_compiled
+        elif isinstance(plan, chaos_mod.CompiledChaos):
+            compiled = self._shard_chaos_schedule(plan)
         else:
-            compiled = chaos_mod.compile_plan(plan, self.cfg.n_groups)
+            compiled = self._shard_chaos_schedule(
+                chaos_mod.compile_plan(plan, self.cfg.n_groups)
+            )
         if plan is self._chaos:
             if self._chaos_compiled is not compiled:
                 self._chaos_compiled = compiled
@@ -4023,6 +4180,7 @@ class ClusterSim:
                 compiled = reconfig_mod.compile_plan(
                     plan, self.cfg.n_groups
                 )
+            compiled = self._shard_reconfig_schedule(compiled)
             if chaos_plan is None or isinstance(
                 chaos_plan, chaos_mod.CompiledChaos
             ):
@@ -4031,6 +4189,7 @@ class ClusterSim:
                 chaos_compiled = chaos_mod.compile_plan(
                     chaos_plan, self.cfg.n_groups
                 )
+            chaos_compiled = self._shard_chaos_schedule(chaos_compiled)
             if split:
                 runner = reconfig_mod.make_split_runner(
                     self.cfg, compiled, chaos_compiled, k=split_k,
@@ -4046,7 +4205,9 @@ class ClusterSim:
             )
         else:
             compiled, runner = cached[2], cached[3]
-        rst = reconfig_mod.init_reconfig_state(self.state)
+        rst = self._place_reconfig_state(
+            reconfig_mod.init_reconfig_state(self.state)
+        )
         fused = None
         if split:
             if wc:
@@ -4165,6 +4326,7 @@ class ClusterSim:
                 compiled = workload_mod.compile_plan(
                     plan, self.cfg.n_groups
                 )
+            compiled = self._shard_client_schedule(compiled)
             if chaos_plan is None or isinstance(
                 chaos_plan, chaos_mod.CompiledChaos
             ):
@@ -4173,6 +4335,7 @@ class ClusterSim:
                 chaos_compiled = chaos_mod.compile_plan(
                     chaos_plan, self.cfg.n_groups
                 )
+            chaos_compiled = self._shard_chaos_schedule(chaos_compiled)
             if reconfig_plan is None or isinstance(
                 reconfig_plan, reconfig_mod.CompiledReconfig
             ):
@@ -4181,6 +4344,9 @@ class ClusterSim:
                 reconfig_compiled = reconfig_mod.compile_plan(
                     reconfig_plan, self.cfg.n_groups
                 )
+            reconfig_compiled = self._shard_reconfig_schedule(
+                reconfig_compiled
+            )
             if split:
                 runner = workload_mod.make_split_runner(
                     self.cfg, compiled, k=split_k,
@@ -4197,8 +4363,13 @@ class ClusterSim:
             )
         else:
             compiled, runner = cached[3], cached[4]
-        rst = reconfig_mod.init_reconfig_state(self.state)
-        rcar = workload_mod.init_read_carry(self.cfg.n_groups)
+        rst = self._place_reconfig_state(
+            reconfig_mod.init_reconfig_state(self.state)
+        )
+        rcar = jax.tree.map(
+            lambda x: self._put(x, True),
+            workload_mod.init_read_carry(self.cfg.n_groups),
+        )
         out = runner(self.state, health, rst, rcar)
         (
             self.state, self._health, _rst, stats, rstats, safety,

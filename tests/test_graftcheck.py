@@ -1374,6 +1374,104 @@ def test_trace_build_failure_is_a_finding():
     assert measured == {}
 
 
+# --- GC015 collective-audit (ISSUE 14): the partitioned executables ------
+
+
+def _coll_spec(name, build, audit=True):
+    from tools.graftcheck.trace.inventory import GraphSpec
+
+    return GraphSpec(
+        name=name,
+        anchor="raft_tpu/multiraft/sharding.py",
+        build=build,
+        const_budget=256,
+        audit_collectives=audit,
+    )
+
+
+def _sharded_input():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = jax.make_mesh((8,), ("g",))
+    return jax.device_put(
+        jnp.zeros((64,), jnp.int32),
+        NamedSharding(mesh, PartitionSpec("g")),
+    )
+
+
+def _psum_build():
+    # A global reduction over the sharded axis: GSPMD must lower it as an
+    # all-reduce — exactly GC015's quarry in a zero-collective graph.
+    import jax
+
+    from tools.graftcheck.trace.inventory import Built
+
+    return Built(jax.jit(lambda x: x.sum()), (_sharded_input(),))
+
+
+def _elementwise_build():
+    import jax
+
+    from tools.graftcheck.trace.inventory import Built
+
+    return Built(jax.jit(lambda x: x + 1), (_sharded_input(),))
+
+
+def test_gc015_unregistered_collective_flags():
+    vs, _ = _trace_run([_coll_spec("coll@fixture", _psum_build)])
+    assert ids(vs) == ["GC015"]
+    assert "all-reduce" in vs[0].message
+    assert "NOT registered" in vs[0].message
+
+
+def test_gc015_zero_collective_graph_passes():
+    vs, _ = _trace_run([_coll_spec("clean@fixture", _elementwise_build)])
+    assert vs == []
+
+
+def test_gc015_allow_registry_accepts(monkeypatch):
+    from tools.graftcheck.trace import analysis
+
+    monkeypatch.setitem(
+        analysis.COLLECTIVE_ALLOW,
+        ("coll@fixture", "all-reduce"),
+        "fixture: the reduction is the graph's whole point",
+    )
+    vs, _ = _trace_run([_coll_spec("coll@fixture", _psum_build)])
+    assert vs == []
+
+
+def test_gc015_stale_allow_entry_flags(monkeypatch):
+    from tools.graftcheck.trace import analysis
+
+    # The graph has NO collectives, so an allow entry for it is rot.
+    monkeypatch.setitem(
+        analysis.COLLECTIVE_ALLOW,
+        ("clean@fixture", "all-reduce"),
+        "obsolete justification",
+    )
+    vs, _ = _trace_run([_coll_spec("clean@fixture", _elementwise_build)])
+    assert ids(vs) == ["GC015"]
+    assert "matches no collective" in vs[0].message
+
+
+def test_gc015_allow_entry_for_unaudited_graph_flags(monkeypatch):
+    from tools.graftcheck.trace import analysis
+
+    monkeypatch.setitem(
+        analysis.COLLECTIVE_ALLOW,
+        ("clean@fixture", "all-gather"),
+        "never matched",
+    )
+    vs, _ = _trace_run(
+        [_coll_spec("clean@fixture", _elementwise_build, audit=False)]
+    )
+    assert ids(vs) == ["GC015"]
+    assert "audit_collectives" in vs[0].message
+
+
 # --- GC014 jaxpr-budget (stdlib: the committed file + the check logic) ---
 
 

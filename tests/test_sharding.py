@@ -18,6 +18,11 @@ def test_mesh_has_8_devices():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow  # ~10s: 30 per-round sharded dispatches; its tier-1
+# role moved to tests/test_sharded_parity.py's scan-parity case (ISSUE 14
+# — the scan path IS the production mesh path now), and the per-round
+# sharded_step graph stays covered by the GC011/GC015 trace audits plus
+# this file's spec cases.
 def test_sharded_step_matches_single_device():
     cfg = SimConfig(n_groups=32, n_peers=3)
     mesh = sharding.make_mesh()
@@ -74,6 +79,88 @@ def test_sharded_read_index_matches_local():
     got = np.asarray(fn(st_sh, jax.device_put(
         crashed, NamedSharding(mesh, P(None, "groups")))))
     np.testing.assert_array_equal(want, got)
+
+
+def test_state_sharding_flag_combinations_two_device_mesh():
+    """state_sharding(damped=, transfer=) on a 2-device mesh (ISSUE 14):
+    every flag combination yields specs whose optional planes appear
+    exactly when flagged, with the group axis sharded and the peer axes
+    local — and sharded_init_state under those specs reproduces
+    init_state bit-exactly with the pairwise planes placed [P, P, G/n]
+    per device."""
+    mesh2 = sharding.make_mesh(2)
+    for damped in (False, True):
+        for transfer in (False, True):
+            specs = sharding.state_sharding(
+                mesh2, damped=damped, transfer=transfer
+            )
+            assert specs.term.spec == P(None, "groups")
+            assert specs.matched.spec == P(None, None, "groups")
+            if damped:
+                assert specs.recent_active.spec == P(None, None, "groups")
+            else:
+                assert specs.recent_active is None
+            if transfer:
+                assert specs.transferee.spec == P(None, "groups")
+            else:
+                assert specs.transferee is None
+            cfg = SimConfig(
+                n_groups=16, n_peers=3,
+                check_quorum=damped, pre_vote=damped, transfer=transfer,
+            )
+            st_sh = sharding.sharded_init_state(cfg, mesh2)
+            st = init_state(cfg)
+            for name in SimState_fields():
+                a, b = getattr(st_sh, name), getattr(st, name)
+                if b is None:
+                    assert a is None, name
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=name
+                )
+            # The pairwise plane really is split on G across the 2
+            # devices: each shard holds [P, P, G/2].
+            shard_shapes = {
+                s.data.shape for s in st_sh.matched.addressable_shards
+            }
+            assert shard_shapes == {(3, 3, 8)}
+
+
+def test_shard_client_packed_word_fallback_two_device_mesh():
+    """shard_client's packed-word replication fallback (ISSUE 14 edge
+    case): on a 2-device mesh a fire plane whose word count does NOT
+    tile the mesh (ceil(G/32) odd) replicates, while an even word count
+    shards on the word axis — contents bit-identical either way."""
+    from raft_tpu.multiraft import workload
+
+    mesh2 = sharding.make_mesh(2)
+    plan = workload.ClientPlan(
+        name="edge",
+        n_peers=3,
+        phases=[workload.ClientPhase(rounds=4, read_every=2,
+                                     read_mode="safe")],
+    )
+    # G=96 -> 3 packed words: 3 % 2 != 0 -> replicate.
+    odd = workload.compile_plan(plan, 96)
+    placed_odd, _ = sharding.shard_client(
+        odd, workload.init_read_carry(96), mesh2
+    )
+    assert placed_odd.read_fire_packed.sharding.spec == P()
+    np.testing.assert_array_equal(
+        np.asarray(placed_odd.read_fire_packed),
+        np.asarray(odd.read_fire_packed),
+    )
+    # G=128 -> 4 packed words: tiles the mesh -> sharded on the word axis.
+    even = workload.compile_plan(plan, 128)
+    placed_even, rcar = sharding.shard_client(
+        even, workload.init_read_carry(128), mesh2
+    )
+    assert placed_even.read_fire_packed.sharding.spec == P(None, "groups")
+    assert rcar.pending_mode.sharding.spec == P("groups")
+    np.testing.assert_array_equal(
+        np.asarray(placed_even.read_fire_packed),
+        np.asarray(even.read_fire_packed),
+    )
 
 
 def test_client_schedule_and_carry_shard_on_groups():

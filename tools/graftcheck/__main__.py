@@ -4,7 +4,7 @@ Flags beyond the basics (docs/STATIC_ANALYSIS.md):
 
   --engine             also run the cross-module abstract-interpretation
                        rules GC007-GC010 (make lint / CI pass this)
-  --trace              also run the trace-level rules GC011-GC014 over the
+  --trace              also run the trace-level rules GC011-GC015 over the
                        lowered graph inventory (imports jax; make lint /
                        the graftcheck-trace CI job pass this)
   --update-budget      regenerate tools/graftcheck/jaxpr_budget.json from
@@ -110,7 +110,14 @@ def _trace_versions() -> str:
     changes every traced jaxpr without touching one repo file, so trace
     results keyed on source mtimes alone would replay stale (the v2
     cache-invalidation gap).  importlib.metadata, not an import — the
-    cache key must be computable without paying the jax import."""
+    cache key must be computable without paying the jax import.
+
+    JAX_PLATFORMS joins the key since GC015 (ISSUE 14): the collective
+    audit's result depends on whether the trace layer could pin its
+    multi-device mesh (it only forces the virtual CPU mesh when the
+    process targets CPU), so a 1-device non-CPU run — which SKIPS GC015
+    — must never be replayed as if it were the audited run."""
+    import os
     from importlib import metadata
 
     parts = []
@@ -119,11 +126,14 @@ def _trace_versions() -> str:
             parts.append(f"{pkg}={metadata.version(pkg)}")
         except metadata.PackageNotFoundError:
             parts.append(f"{pkg}=absent")
+    parts.append(
+        "platforms=" + (os.environ.get("JAX_PLATFORMS", "") or "<unset>")
+    )
     return ",".join(parts)
 
 
 def _run_trace_cached(args, ctx: "Context", repo_root: Path) -> Optional[List[Violation]]:
-    """Run (or cache-replay) the GC011-GC014 trace layer; None = hard
+    """Run (or cache-replay) the GC011-GC015 trace layer; None = hard
     failure already reported (missing jax)."""
     from . import trace as trace_pkg
 
@@ -184,7 +194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--trace",
         action="store_true",
-        help="also run the trace-level rules GC011-GC014 over the lowered "
+        help="also run the trace-level rules GC011-GC015 over the lowered "
         "graph inventory (imports jax)",
     )
     ap.add_argument(
@@ -354,7 +364,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # the trace layer keys on raft_tpu + jax versions, not the
                 # scanned files, and its own cache replays an unchanged
                 # inventory in ~0.3s — an early return here would silently
-                # skip GC011-GC014 in the pre-commit hook.
+                # skip GC011-GC015 in the pre-commit hook.
                 scan_paths = kept
 
     # The cache fingerprints repo files only; a reference checkout (GC005

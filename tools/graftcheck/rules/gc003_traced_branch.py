@@ -52,6 +52,7 @@ _STATIC_CONFIG_FIELDS = {
     "pre_vote",
     "transfer",
     "lease_read",
+    "spmd",
     "min_timeout",
     "max_timeout",
 }

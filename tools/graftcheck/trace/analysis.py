@@ -25,6 +25,15 @@ What each rule proves, and why the SOURCE-level twin cannot:
   callback smuggled through a helper in another module still lands an
   eqn in the traced graph — and that eqn, not the spelling, is what
   serializes every dispatch.
+* **GC015 collective-audit** (ISSUE 14) — the sharded inventory rows,
+  compiled over the multi-device audit mesh, must contain EXACTLY the
+  cross-partition collectives registered for them in COLLECTIVE_ALLOW:
+  zero for the steady step/scan graphs (the "embarrassingly parallel
+  across G" claim of sharding.py, machine-checked), the psum/pmin set
+  for the status/drain reductions.  Only the PARTITIONED executable
+  knows what GSPMD inserted — a global reduction that looks innocent in
+  the jaxpr (a cond predicate, a stat fold) lowers to a per-round
+  all-reduce on the mesh.
 """
 
 from __future__ import annotations
@@ -38,11 +47,29 @@ import jax.tree_util as jtu
 
 from ..core import Context, Violation
 from . import budget as budget_mod
-from .inventory import DONATION_ALLOW, REGISTRY, Built, GraphSpec
+from .inventory import (
+    COLLECTIVE_ALLOW,
+    DONATION_ALLOW,
+    REGISTRY,
+    Built,
+    GraphSpec,
+)
 
 GC011, GC011_SLUG = "GC011", "donation-audit"
 GC012, GC012_SLUG = "GC012", "constant-capture"
 GC013, GC013_SLUG = "GC013", "host-sync-in-graph"
+GC015, GC015_SLUG = "GC015", "collective-audit"
+
+# Cross-partition collective opcodes in optimized HLO text; -start/-done
+# async pairs normalize to the base opcode.  `partition-id` and
+# `replica-id` are deliberately absent: they are cheap local reads, not
+# cross-chip traffic.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+\S+\s+("
+    r"all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast|ragged-all-to-all"
+    r")(?:-start|-done)?\("
+)
 
 # Primitives that move control or data across the host boundary (or pin a
 # transfer) inside a traced graph.  `debug_print` is jax.debug.print's
@@ -282,6 +309,107 @@ def check_consts(spec: GraphSpec, closed) -> Iterator[Violation]:
         )
 
 
+def collect_collectives(hlo_text: str) -> Set[str]:
+    """Base opcodes of every cross-partition collective in the compiled
+    module's text."""
+    return {m.group(1) for m in _COLLECTIVE_RE.finditer(hlo_text)}
+
+
+def check_collectives(
+    spec: GraphSpec, compiled_text: str
+) -> Tuple[List[Violation], Set[Tuple[str, str]]]:
+    """GC015 over one compiled artifact (ISSUE 14): the module's
+    collective-op set must equal EXACTLY the opcodes registered for this
+    graph in COLLECTIVE_ALLOW.  Zero registered opcodes is the strongest
+    claim — the steady sharded step/scan graphs carry NO cross-chip
+    traffic (sharding.py's "embarrassingly parallel across G", machine-
+    checked the GC011 way).  Returns (violations, used allow keys) so the
+    stale-entry check can spot rot."""
+    violations: List[Violation] = []
+    used: Set[Tuple[str, str]] = set()
+    found = collect_collectives(compiled_text)
+    for op in sorted(found):
+        key = (spec.name, op)
+        if str(COLLECTIVE_ALLOW.get(key, "")).strip():
+            used.add(key)
+            continue
+        violations.append(
+            _v(
+                spec,
+                GC015,
+                GC015_SLUG,
+                f"graph {spec.name!r} contains a `{op}` collective that is "
+                "NOT registered for it in COLLECTIVE_ALLOW — cross-chip "
+                "traffic crept into a graph audited as "
+                + (
+                    "collective-free (the steady mesh path must stay "
+                    "embarrassingly parallel across G)"
+                    if not any(
+                        n == spec.name for n, _ in COLLECTIVE_ALLOW
+                    )
+                    else "having exactly its registered reduction set"
+                )
+                + "; remove the reduction from the hot graph or register "
+                "it with a justification "
+                "(tools/graftcheck/trace/inventory.py)",
+            )
+        )
+    return violations, used
+
+
+def check_stale_collective_allows(
+    used: Set[Tuple[str, str]],
+    audited: Set[str],
+    compiled_ok: Set[str],
+    spec_names: Set[str],
+    full_registry: bool = True,
+) -> Iterator[Violation]:
+    """A COLLECTIVE_ALLOW entry that matches no compiled collective is rot
+    (the GC000 discipline, mirroring the donation allow-registry).
+    `audited` is the REGISTRY intent (audit_collectives=True rows) and
+    `compiled_ok` the graphs whose compile actually succeeded: a graph
+    that failed to build already reported a GC000 finding, and its allow
+    entries must NOT be misread as stale (deleting them on that advice
+    would fail the build again once the graph compiles).  On a partial
+    run (fixture specs, --rule subsets) entries naming graphs outside
+    the selected set are SKIPPED rather than misread as typos — only the
+    full-registry run can tell rot from not-selected."""
+    anchor = "tools/graftcheck/trace/inventory.py"
+    for key, reason in sorted(COLLECTIVE_ALLOW.items()):
+        name, op = key
+        if not full_registry and name not in spec_names:
+            continue
+        if name not in spec_names:
+            yield Violation(
+                anchor, 1, GC015, GC015_SLUG,
+                f"COLLECTIVE_ALLOW entry {key!r} names no inventoried "
+                f"graph ({name!r} is not in the registry) — typo'd or "
+                "removed; delete the stale entry",
+            )
+        elif name not in audited:
+            yield Violation(
+                anchor, 1, GC015, GC015_SLUG,
+                f"COLLECTIVE_ALLOW entry {key!r} names graph {name!r}, "
+                "whose registry row does not set audit_collectives=True — "
+                "the entry can never match; delete it (or enable the "
+                "audit)",
+            )
+        elif name in compiled_ok and key not in used:
+            yield Violation(
+                anchor, 1, GC015, GC015_SLUG,
+                f"COLLECTIVE_ALLOW entry {key!r} matches no collective in "
+                "the compiled graph — the reduction is gone; delete the "
+                "stale entry",
+            )
+        if not str(reason).strip():
+            yield Violation(
+                anchor, 1, GC015, GC015_SLUG,
+                f"COLLECTIVE_ALLOW entry {key!r} has no justification; "
+                "explain why this cross-chip reduction belongs in the "
+                "graph",
+            )
+
+
 def check_host_sync(spec: GraphSpec, closed) -> Iterator[Violation]:
     """GC013 over one traced graph."""
     bad = sorted(collect_primitives(closed) & HOST_SYNC_PRIMITIVES)
@@ -300,13 +428,37 @@ def check_host_sync(spec: GraphSpec, closed) -> Iterator[Violation]:
 # --- the driver -------------------------------------------------------------
 
 
+def _pin_audit_mesh() -> None:
+    """Pin the canonical audit environment: the virtual 8-device CPU mesh
+    tests/conftest.py uses.  The GC015 collective audit inspects the
+    PARTITIONED executables, so the sharded inventory rows need a real
+    multi-device mesh; jaxpr eqn counts and alias maps are device-count
+    independent, so the other rules are unaffected.  Only engages when
+    the process targets CPU (JAX_PLATFORMS unset or cpu — a real TPU
+    host keeps its devices) and is a guarded no-op once a backend is
+    live (force_virtual_cpu swallows the late-config RuntimeError)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    if plat not in ("", "cpu"):
+        return
+    try:
+        from raft_tpu.platform import force_virtual_cpu
+
+        force_virtual_cpu(8)
+    except Exception:
+        pass
+
+
 def trace_inventory(
     specs: Optional[Sequence[GraphSpec]] = None,
 ) -> Tuple[List[Violation], Dict[str, int]]:
-    """Build every inventoried graph and run GC011-GC013; returns the
-    violations plus the measured eqn counts for GC014 (budget.py)."""
+    """Build every inventoried graph and run GC011-GC013 + GC015; returns
+    the violations plus the measured eqn counts for GC014 (budget.py)."""
+    full_registry = specs is None
     if specs is None:
         specs = REGISTRY
+    _pin_audit_mesh()
     try:
         # GC011 pays real XLA compiles; the opt-in persistent cache
         # (RAFT_TPU_COMPILE_CACHE — same cache CI shares with the tier-1
@@ -320,6 +472,23 @@ def trace_inventory(
     measured: Dict[str, int] = {}
     declined_seen: Set[Tuple[str, str]] = set()
     audited: Set[str] = set()
+    collective_used: Set[Tuple[str, str]] = set()
+    collective_compiled: Set[str] = set()
+    multi_device = jax.device_count() >= 2
+    # Registry INTENT, not compile success: a row whose build fails must
+    # not make the stale-allow sweep misadvise deleting its entries.
+    collective_audited: Set[str] = {
+        s.name for s in specs if s.audit_collectives
+    }
+    if not multi_device and any(s.audit_collectives for s in specs):
+        import sys
+
+        print(
+            "graftcheck: GC015 collective audit SKIPPED — only one device "
+            "visible (needs the virtual multi-device mesh; the multichip "
+            "CI job is the backstop)",
+            file=sys.stderr,
+        )
     for spec in specs:
         try:
             built = spec.build()
@@ -338,8 +507,13 @@ def trace_inventory(
         measured[spec.name] = count_eqns(closed)
         violations.extend(check_consts(spec, closed))
         violations.extend(check_host_sync(spec, closed))
+        audit_coll = spec.audit_collectives and multi_device
         if spec.audit_donation:
+            # Registry intent (pre-compile): matches the collective set's
+            # discipline — a build failure is its own GC000 finding, not
+            # a license to misread allow entries as stale.
             audited.add(spec.name)
+        if spec.audit_donation or audit_coll:
             try:
                 with warnings.catch_warnings():
                     # The "donated buffers were not usable" UserWarning is
@@ -349,8 +523,9 @@ def trace_inventory(
                     # The drift check must be BIDIRECTIONAL: a wrapper
                     # that starts donating while its registry row still
                     # declares none is drift too, so every graph pays the
-                    # cheap lower(); the expensive compile (alias map)
-                    # runs only when either side declares a donation.
+                    # cheap lower(); the expensive compile runs only when
+                    # either side declares a donation — or when GC015
+                    # needs the partitioned module's collective set.
                     flat_info = jtu.tree_flatten_with_path(
                         lowered.args_info
                     )[0]
@@ -360,7 +535,7 @@ def trace_inventory(
                     )
                     compiled_text = (
                         lowered.compile().as_text()
-                        if built.donate or lowering_donates
+                        if built.donate or lowering_donates or audit_coll
                         else ""
                     )
             except Exception as e:
@@ -370,20 +545,39 @@ def trace_inventory(
                         "GC000",
                         "trace-build-error",
                         f"graph {spec.name!r} failed to compile for the "
-                        f"donation audit: {type(e).__name__}: {e}",
+                        f"donation/collective audit: "
+                        f"{type(e).__name__}: {e}",
                     )
                 )
                 continue
-            donation_violations, declined = check_donation(
-                spec, built, compiled_text, lowered.args_info
-            )
-            violations.extend(donation_violations)
-            declined_seen.update(declined)
+            if spec.audit_donation:
+                donation_violations, declined = check_donation(
+                    spec, built, compiled_text, lowered.args_info
+                )
+                violations.extend(donation_violations)
+                declined_seen.update(declined)
+            if audit_coll:
+                collective_compiled.add(spec.name)
+                coll_violations, used = check_collectives(
+                    spec, compiled_text
+                )
+                violations.extend(coll_violations)
+                collective_used.update(used)
     violations.extend(
         check_stale_donation_allows(
             declined_seen, audited, {spec.name for spec in specs}
         )
     )
+    if multi_device:
+        violations.extend(
+            check_stale_collective_allows(
+                collective_used,
+                collective_audited,
+                collective_compiled,
+                {spec.name for spec in specs},
+                full_registry=full_registry,
+            )
+        )
     return violations, measured
 
 

@@ -57,6 +57,50 @@ DEFAULT_CONST_BYTES = 64
 # only for donations XLA genuinely cannot honor.
 DONATION_ALLOW: Dict[Tuple[str, str], str] = {}
 
+# The sharded-row audit shape (ISSUE 14).  Unlike the jaxpr-size rows,
+# the GC015 collective audit inspects the PARTITIONED executable, so the
+# shape must be large enough that every sharded axis actually tiles the
+# 8-device audit mesh — in particular the packed bits_g recent_active
+# carry's word axis (G/32 words needs G >= 32 * 8) — or the partitioner
+# would legitimately insert gathers a production shape never sees.
+G_SHARDED = 256
+
+# GC015 allow-registry: (graph name, HLO collective opcode) ->
+# justification.  A graph row with audit_collectives=True must contain
+# EXACTLY the opcodes registered for it — an unregistered collective in
+# the compiled module fails the build (the steady step/scan rows register
+# none: that is the machine-checked "embarrassingly parallel across G"
+# claim of sharding.py), and a registered opcode that no longer appears
+# is rot, exactly like a stale DONATION_ALLOW entry.
+COLLECTIVE_ALLOW: Dict[Tuple[str, str], str] = {
+    (
+        "sharded_status@spmd", "all-reduce",
+    ): "the status reduction IS the cross-chip contract: psum(n_leaders)/"
+       "psum(total_commit limbs)/pmin(commit)/pmax(term) all lower to "
+       "all-reduce over ICI (sharding.global_status)",
+    (
+        "sharded_drain@health", "all-reduce",
+    ): "the health-summary drain reduces threshold counts and the "
+       "commit-lag histogram across shards (kernels.health_summary under "
+       "the mesh) — the fixed-size summary is the only thing that leaves "
+       "the device",
+    (
+        "sharded_drain@health", "all-gather",
+    ): "health_summary's lax.top_k worst-offender extraction gathers the "
+       "per-shard score vector before the global sort — O(topk + G) "
+       "bytes once per drain cadence, never per round",
+    (
+        "sharded_scan@counters+spmd", "all-reduce",
+    ): "the event-counter fold (kernels.count_events) psums per-round "
+       "event counts into the [N_COUNTERS] replicated plane — the "
+       "instrumented configuration's documented ICI cost, off by default",
+    (
+        "sharded_dispatch@spmd", "all-reduce",
+    ): "fast_multi_round's fused-vs-general lax.cond predicate "
+       "(pallas_step.steady_mask) is a global all() — one scalar "
+       "all-reduce per K-round block, amortized 1/K per round",
+}
+
 
 class Built(NamedTuple):
     """One constructed artifact: the (jitted) callable, example args at
@@ -78,6 +122,13 @@ class GraphSpec:
     # compile (alias map) runs only when either side declares a donation.
     audit_donation: bool = True
     const_budget: int = DEFAULT_CONST_BYTES
+    # GC015 (ISSUE 14): compile the graph over the multi-device audit
+    # mesh and require its collective-op set to equal EXACTLY the opcodes
+    # registered for it in COLLECTIVE_ALLOW (none registered = the
+    # zero-collectives proof).  Only meaningful for graphs built over a
+    # mesh; needs >= 2 devices (trace_inventory pins the virtual
+    # 8-device CPU mesh).
+    audit_collectives: bool = False
 
 
 # --- builders ---------------------------------------------------------------
@@ -527,27 +578,126 @@ def _workload_split_builder():
     return build
 
 
+def _sharded_mesh():
+    """The GC015 audit mesh: up to 8 devices (the virtual CPU mesh
+    trace_inventory pins; a 1-device fallback keeps the non-collective
+    checks runnable anywhere, with GC015 skipped loudly)."""
+    import jax
+
+    from raft_tpu.multiraft import sharding
+
+    return sharding.make_mesh(min(8, len(jax.devices())))
+
+
+def _sharded_args(cfg, mesh):
+    """Mesh-placed (state, crashed, append_n) at the sharded audit shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from raft_tpu.multiraft import sharding
+
+    st = sharding.sharded_init_state(cfg, mesh)
+    crashed = jax.device_put(
+        jnp.zeros((P, G_SHARDED), bool),
+        NamedSharding(mesh, PartitionSpec(None, "groups")),
+    )
+    append_n = jax.device_put(
+        jnp.zeros((G_SHARDED,), jnp.int32),
+        NamedSharding(mesh, PartitionSpec("groups")),
+    )
+    return st, crashed, append_n
+
+
 def _sharded_builder(kind: str):
     def build() -> Built:
-        import jax
-
         from raft_tpu.multiraft import sharding
 
         sim = _sim()
-        cfg = sim.SimConfig(n_groups=G, n_peers=P)
-        mesh = sharding.make_mesh(1, devices=jax.devices())
-        st, crashed, append_n = _base_args(cfg)
-        st = sharding.shard_state(st, mesh)
+        # The production mesh config (ClusterSim(mesh=) sets it the same
+        # way): spmd=True swaps the election cond's global-any predicate
+        # — the one collective the plain step graph would otherwise
+        # carry — for its bit-identical masked form.
+        cfg = sim.SimConfig(n_groups=G_SHARDED, n_peers=P, spmd=True)
+        mesh = _sharded_mesh()
+        st, crashed, append_n = _sharded_args(cfg, mesh)
         if kind == "step":
             return Built(
                 sharding.sharded_step(cfg, mesh), (st, crashed, append_n),
                 (0,),
             )
         if kind == "status":
-            return Built(sharding.global_status(cfg, mesh), (st,))
+            return Built(sharding.global_status(cfg, mesh).jitted, (st,))
         return Built(
             sharding.sharded_read_index(cfg, mesh), (st, crashed)
         )
+
+    return build
+
+
+def _sharded_scan_builder(flags: dict, damping: dict):
+    """ClusterSim(mesh=).run_compiled's donated scan segment — the ISSUE
+    14 steady mesh path, exactly as the production wrapper builds it
+    (sharded init, placed planes, whole carry donated)."""
+
+    def build() -> Built:
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G_SHARDED, n_peers=P, **flags, **damping
+        )
+        mesh = _sharded_mesh()
+        cs = sim.ClusterSim(cfg, mesh=mesh)
+        _, crashed, append_n = _sharded_args(cs.cfg, mesh)
+        runner = cs._compiled_runner(SCAN_ROUNDS, has_link=False)
+        args: tuple = (cs.state, crashed, append_n)
+        donate: Tuple[int, ...] = (0,)
+        if cfg.collect_counters:
+            args = args + (cs._counters,)
+            donate = donate + (len(args) - 1,)
+        if cfg.collect_health:
+            args = args + (cs._health,)
+            donate = donate + (len(args) - 1,)
+        return Built(runner, args, donate)
+
+    return build
+
+
+def _sharded_drain_builder():
+    """The mesh drain reduction: kernels.health_summary over the sharded
+    health planes (what _begin_drain dispatches device-side) — the
+    fixed-size summary is the only cross-chip product."""
+
+    def build() -> Built:
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G_SHARDED, n_peers=P, collect_health=True, spmd=True
+        )
+        mesh = _sharded_mesh()
+        cs = sim.ClusterSim(cfg, mesh=mesh)
+        return Built(cs._summary_fn, (cs._health.planes,))
+
+    return build
+
+
+def _sharded_dispatch_builder():
+    """fast_multi_round under the mesh: the fused kernel (interpret mode
+    partitions as plain XLA ops), the k general steps, and the steady-
+    predicate cond — the per-shard fused-block ride of ISSUE 14."""
+
+    def build() -> Built:
+        import jax
+
+        from raft_tpu.multiraft import pallas_step
+
+        sim = _sim()
+        cfg = sim.SimConfig(n_groups=G_SHARDED, n_peers=P, spmd=True)
+        mesh = _sharded_mesh()
+        st, crashed, append_n = _sharded_args(cfg, mesh)
+        fn = pallas_step.fast_multi_round(
+            cfg, k=DISPATCH_K,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return Built(jax.jit(fn), (st, crashed, append_n))
 
     return build
 
@@ -743,20 +893,83 @@ def _specs() -> List[GraphSpec]:
     sharding_py = "raft_tpu/multiraft/sharding.py"
     out.append(
         GraphSpec(
-            name="sharded_step@plain", anchor=sharding_py,
+            # The steady sharded step: ZERO collectives registered — this
+            # row IS the machine-checked "embarrassingly parallel across
+            # G" claim of sharding.py's docstring (SimConfig.spmd removes
+            # the election cond's global-any predicate).
+            name="sharded_step@spmd", anchor=sharding_py,
             build=_sharded_builder("step"),
+            audit_collectives=True,
         )
     )
     out.append(
         GraphSpec(
-            name="sharded_status@plain", anchor=sharding_py,
+            # The ICI status reduction: exactly its psum/pmin set
+            # (COLLECTIVE_ALLOW) — including the ISSUE 14 total_commit
+            # limb psums that replaced the wrapping single int32 sum.
+            name="sharded_status@spmd", anchor=sharding_py,
             build=_sharded_builder("status"),
+            audit_collectives=True,
         )
     )
     out.append(
         GraphSpec(
-            name="sharded_read_index@plain", anchor=sharding_py,
+            name="sharded_read_index@spmd", anchor=sharding_py,
             build=_sharded_builder("read_index"),
+            audit_collectives=True,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # ClusterSim(mesh=).run_compiled's donated steady scan
+            # segment (ISSUE 14): whole carry donated under
+            # jit-with-shardings, zero collectives.
+            name="sharded_scan@spmd", anchor=sharding_py,
+            build=_sharded_scan_builder({}, {}),
+            audit_collectives=True,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The damped mesh scan: the packed bits_g recent_active carry
+            # sharded on its group-minor word axis (G_SHARDED/32 words
+            # tile the 8-device mesh), donated through the pack/unpack
+            # boundary, still zero collectives.
+            name="sharded_scan@spmd+cq+pv", anchor=sharding_py,
+            build=_sharded_scan_builder(
+                {}, {"check_quorum": True, "pre_vote": True}
+            ),
+            audit_collectives=True,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The instrumented mesh scan: the event-counter fold psums
+            # per round (registered) — the documented ICI cost of
+            # collect_counters on a mesh.
+            name="sharded_scan@counters+spmd", anchor=sharding_py,
+            build=_sharded_scan_builder({"collect_counters": True}, {}),
+            audit_collectives=True,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The drain-cadence health reduction under the mesh: its
+            # registered all-reduce/all-gather set and nothing else.
+            name="sharded_drain@health", anchor=sharding_py,
+            build=_sharded_drain_builder(),
+            audit_collectives=True,
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The fused dispatcher riding per-shard (ISSUE 14): only the
+            # steady-predicate cond's scalar all-reduce, once per K-round
+            # block.
+            name="sharded_dispatch@spmd",
+            anchor="raft_tpu/multiraft/pallas_step.py",
+            build=_sharded_dispatch_builder(),
+            audit_collectives=True,
         )
     )
     return out

@@ -54,10 +54,23 @@ class JaxprBudgetRule(Rule):
         return False
 
 
+class CollectiveAuditRule(Rule):
+    id = "GC015"
+    slug = "collective-audit"
+    doc = (
+        "sharded graphs contain exactly their registered cross-chip "
+        "collective set (zero for the steady step/scan) (--trace)"
+    )
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
 def trace_rules() -> List[Rule]:
     return [
         DonationAuditRule(),
         ConstantCaptureRule(),
         HostSyncInGraphRule(),
         JaxprBudgetRule(),
+        CollectiveAuditRule(),
     ]

@@ -1,0 +1,174 @@
+"""Sharded-vs-unsharded golden-corpus parity report (ISSUE 14).
+
+Replays every scenario of the chaos corpus (tests/testdata/chaos/
+plans.json) and the reconfig corpus (tests/testdata/reconfig/plans.json)
+TWICE — once through ClusterSim(mesh=) over the virtual 8-device CPU
+mesh (the production multi-chip path: sharded bootstrap, donated
+run_compiled-style scans, compiled schedules replayed cross-chip) and
+once single-device — and requires BIT-IDENTITY: every SimState plane,
+the health planes, and the full scenario report (MTTR, op-protocol
+counts, safety-invariant counts) must match exactly.  Any divergence,
+and any nonzero safety count in either run, exits non-zero.
+
+This is the CI half of the ISSUE 14 exactness acceptance (the pytest
+half is tests/test_sharded_parity.py; the heavy corpus cases there are
+slow-marked, so this tool is what runs every build).  The report JSON
+uploads as a CI artifact:
+
+    {"groups": 64, "n_devices": 8, "ok": true,
+     "chaos": {"symmetric-split": {"match": true, "safety_clean": true,
+               "mttr_rounds": ...}, ...},
+     "reconfig": {...}}
+
+Usage:  python tools/sharded_parity_report.py [--groups N] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from raft_tpu.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
+
+import numpy as np  # noqa: E402
+
+TESTDATA = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "testdata"
+)
+
+
+def _state_diffs(a, b) -> list:
+    from raft_tpu.multiraft import sim as sim_mod
+
+    diffs = []
+    for name in sim_mod.SimState._fields:
+        x, y = getattr(a, name), getattr(b, name)
+        if x is None or y is None:
+            if x is not y:
+                diffs.append(name)
+            continue
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            diffs.append(name)
+    return diffs
+
+
+def _pair_result(a, b, ra: dict, rb: dict) -> dict:
+    diffs = _state_diffs(a.state, b.state)
+    if not np.array_equal(
+        np.asarray(a._health.planes), np.asarray(b._health.planes)
+    ):
+        diffs.append("health.planes")
+    if ra != rb:
+        diffs.append("report")
+    safety_clean = not any(ra.get("safety", {"x": 1}).values())
+    out = {
+        "match": not diffs,
+        "safety_clean": safety_clean,
+        "mttr_rounds": ra.get("mttr_rounds"),
+    }
+    if diffs:
+        out["diverged"] = diffs
+    return out
+
+
+def run_chaos_corpus(groups: int) -> dict:
+    from raft_tpu.multiraft import ClusterSim, SimConfig, chaos, sharding
+
+    with open(
+        os.path.join(TESTDATA, "chaos", "plans.json"), encoding="utf-8"
+    ) as f:
+        plans = json.load(f)
+    mesh = sharding.make_mesh()
+    out = {}
+    for doc in plans:
+        plan = chaos.plan_from_dict(doc)
+        cfg = SimConfig(
+            n_groups=groups, n_peers=plan.n_peers, collect_health=True
+        )
+        a = ClusterSim(cfg, mesh=mesh, chaos=plan)
+        b = ClusterSim(cfg, chaos=plan)
+        out[plan.name] = _pair_result(a, b, a.run_plan(), b.run_plan())
+    return out
+
+
+def run_reconfig_corpus(groups: int) -> dict:
+    from raft_tpu.multiraft import (
+        ClusterSim,
+        SimConfig,
+        chaos,
+        reconfig,
+        sharding,
+    )
+
+    with open(
+        os.path.join(TESTDATA, "reconfig", "plans.json"), encoding="utf-8"
+    ) as f:
+        plans = json.load(f)
+    mesh = sharding.make_mesh()
+    out = {}
+    for doc in plans:
+        plan = reconfig.plan_from_dict(doc["reconfig"])
+        cplan = chaos.plan_from_dict(doc["chaos"])
+        cfg = SimConfig(
+            n_groups=groups, n_peers=plan.n_peers, collect_health=True
+        )
+        vm, om, lm = reconfig.initial_masks(plan, groups)
+        a = ClusterSim(
+            cfg, voter_mask=vm, outgoing_mask=om, learner_mask=lm,
+            mesh=mesh,
+        )
+        b = ClusterSim(
+            cfg, voter_mask=vm, outgoing_mask=om, learner_mask=lm
+        )
+        out[plan.name] = _pair_result(
+            a, b,
+            a.run_reconfig(plan, chaos_plan=cplan),
+            b.run_reconfig(plan, chaos_plan=cplan),
+        )
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--groups", type=int, default=64)
+    ap.add_argument("--out", default="", metavar="FILE")
+    args = ap.parse_args()
+
+    import jax
+
+    report = {
+        "groups": args.groups,
+        "n_devices": len(jax.devices()),
+        "chaos": run_chaos_corpus(args.groups),
+        "reconfig": run_reconfig_corpus(args.groups),
+    }
+    bad = []
+    for corpus in ("chaos", "reconfig"):
+        for name, res in report[corpus].items():
+            if not res["match"]:
+                bad.append(
+                    f"{corpus}/{name}: sharded run DIVERGED on "
+                    f"{res.get('diverged')}"
+                )
+            if not res["safety_clean"]:
+                bad.append(f"{corpus}/{name}: nonzero safety counts")
+    report["ok"] = not bad
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(report["chaos"], sort_keys=True))
+    print(json.dumps(report["reconfig"], sort_keys=True))
+    for msg in bad:
+        print(f"ERROR: {msg}", file=sys.stderr)
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
