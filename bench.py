@@ -483,6 +483,66 @@ def bench_device(
     }
 
 
+def bench_blackbox(groups: int = G, reps: int = REPS) -> dict:
+    """Measure the ISSUE 15 black-box instrumentation overhead.
+
+    General path: the donated run_compiled scan with SimConfig.blackbox
+    off vs on (the per-round ring/trip fold riding step(blackbox=)).
+    Fused path: blackbox-on conservatively rejects every fused horizon
+    (pallas_step.steady_mask v1), so the honest fused-path cost of
+    turning forensics on is the gap between the fused dispatcher
+    (blackbox off, steady predicate engaged — bench_device's timed loop)
+    and the blackbox-on GENERAL scan: `blackbox_overhead_fused_pct`
+    includes the defusion, which is the price a production fused
+    configuration actually pays (docs/PERF.md "Black-box overhead")."""
+    from raft_tpu.multiraft.sim import ClusterSim, SimConfig
+
+    crashed = jnp.zeros((P, groups), bool)
+    append = jnp.ones((groups,), jnp.int32)
+
+    def run_general(blackbox: bool) -> dict:
+        cfg = SimConfig(n_groups=groups, n_peers=P, blackbox=blackbox)
+        cs = ClusterSim(cfg)
+        # Settle the election storm, then warm the segment compile.
+        for _ in range(30):
+            cs.run_round(crashed, append)
+        cs.run_compiled(ROUNDS_PER_SCAN, append_n=append)
+        jax.block_until_ready(cs.state.commit)
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(SCANS):
+                cs.run_compiled(ROUNDS_PER_SCAN, append_n=append)
+            jax.block_until_ready(cs.state.commit)
+            samples.append(
+                groups * ROUNDS_PER_SCAN * SCANS
+                / (time.perf_counter() - t0)
+            )
+        assert int(jnp.min(jnp.max(cs.state.commit, axis=0))) > 0, (
+            "bench sanity: no commits on device"
+        )
+        return rep_stats(samples)
+
+    general_off = run_general(False)
+    general_on = run_general(True)
+    fused_off = bench_device(groups, reps)
+
+    def overhead(base: dict, instrumented: dict) -> float:
+        return round(
+            100.0 * (base["median"] - instrumented["median"])
+            / base["median"],
+            2,
+        )
+
+    return {
+        "general_off": general_off,
+        "general_on": general_on,
+        "fused_off": fused_off,
+        "blackbox_overhead_pct": overhead(general_off, general_on),
+        "blackbox_overhead_fused_pct": overhead(fused_off, general_on),
+    }
+
+
 def bench_chaos(
     plan_path: str, groups: int, reps: int, chaos_out: str = "",
     check_quorum: bool = False,
@@ -1255,6 +1315,7 @@ def main() -> None:
     ap.add_argument("--autopilot-out", default="", metavar="FILE")
     ap.add_argument("--reads", default="", metavar="PLAN_JSON")
     ap.add_argument("--reads-out", default="", metavar="FILE")
+    ap.add_argument("--blackbox", action="store_true")
     ap.add_argument("--mesh", type=int, default=0, metavar="N_DEVICES")
     ap.add_argument("--cadence", type=int, default=16)
     ap.add_argument("--split-k", type=int, default=8)
@@ -1320,6 +1381,31 @@ def main() -> None:
                  "variant)")
     if args.mesh < 0:
         ap.error("--mesh needs a positive device count")
+    if args.blackbox and (
+        args.chaos or args.reconfig or args.prod_fused or args.autopilot
+        or args.reads or args.mesh or args.health or args.lossy >= 0.0
+        or args.check_quorum
+    ):
+        ap.error("--blackbox is its own mode (the ISSUE 15 "
+                 "instrumented-vs-off overhead measurement)")
+
+    if args.blackbox:
+        bb_stats = bench_blackbox(args.groups, args.reps)
+        for tag in ("general_off", "general_on", "fused_off"):
+            warn_spread(f"blackbox {tag}", bb_stats[tag])
+        line = {
+            "metric": "raft_blackbox_overhead",
+            "value": bb_stats["blackbox_overhead_pct"],
+            "unit": "pct",
+            "groups": args.groups,
+            "blackbox": True,
+            **bb_stats,
+        }
+        # Deliberately no --check gate: the overhead is documented in
+        # docs/PERF.md, not a first-class baseline configuration (the
+        # ISSUE 15 satellite's call).
+        print(json.dumps(line))
+        return
 
     if args.mesh:
         import os

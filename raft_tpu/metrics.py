@@ -540,6 +540,17 @@ class Metrics:
             "(lead_transferee set at the acting leader)",
         )
 
+        # Forensics plane (multiraft/forensics.py, ISSUE 15): offender
+        # groups captured by the device black box, by safety slot —
+        # HealthMonitor.record_incident increments by the newly-captured
+        # delta, so the counter tracks cumulative distinct offenders.
+        self.safety_incidents = r.counter(
+            "multiraft_safety_incidents_total",
+            "Safety-invariant offender groups captured by the black-box "
+            "forensics layer, by slot",
+            ("slot",),
+        )
+
     # --- tracing ---
 
     def trace(self, event: str, **fields) -> None:

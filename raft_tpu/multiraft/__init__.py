@@ -27,10 +27,12 @@ from .kernels import (
     vote_result,
 )
 from .sim import (
+    BlackboxState,
     ClusterSim,
     HealthState,
     SimConfig,
     SimState,
+    init_blackbox,
     init_health,
     read_index,
 )
@@ -59,11 +61,14 @@ __all__ = [
     "SimState",
     "HealthState",
     "init_health",
+    "BlackboxState",
+    "init_blackbox",
     "ScalarCluster",
     "HealthOracle",
     "read_index",
     # submodules imported lazily to keep jax-light paths cheap:
     #   .chaos     fault-plan compiler + compiled-schedule runner
+    #   .forensics black-box incident extraction + one-group scalar repro
     #   .reconfig  membership-churn plan compiler + compiled-schedule runner
     #   .autopilot closed-loop control plane (kick/transfer/evacuate)
     #   .workload  client read/write plan compiler + compiled-schedule runner

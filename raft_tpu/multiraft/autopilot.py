@@ -192,8 +192,14 @@ def make_cadence_runner(
             with_chaos=chaos_compiled is not None, interpret=interpret,
         )
 
-    def run(st, hl, rst, stats, rstats, safety, csr, r0, transfer, kick,
-            *sched_args):
+    with_bb = cfg.blackbox
+
+    def run(st, hl, rst, stats, rstats, safety, *rest):
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            bb, csr, r0, transfer, kick, *sched_args = rest
+        else:
+            csr, r0, transfer, kick, *sched_args = rest
+            bb = None
         sched, chaos_sched = _rebuild_scheds(
             compiled, chaos_compiled, sched_args
         )
@@ -218,10 +224,14 @@ def make_cadence_runner(
             )
             return carry
 
+        # _runner_body carries the optional BlackboxState LAST in its
+        # inner tuple, so the cadence carry is (..., safety[, bb], csr).
+        inner0 = (st, hl, rst, stats, rstats, safety)
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            inner0 = inner0 + (bb,)
+
         if not fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
-            return general((st, hl, rst, stats, rstats, safety, csr)) + (
-                jnp.int32(0),
-            )
+            return general(inner0 + (csr,)) + (jnp.int32(0),)
 
         if chaos_compiled is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             link, loss, crashed, capp = chaos_mod.schedule_planes(
@@ -275,7 +285,11 @@ def make_cadence_runner(
         )
 
         def fast(args):
-            st, hl, rst, stats, rstats, safety, csr = args
+            if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+                st, hl, rst, stats, rstats, safety, bb, csr = args
+            else:
+                st, hl, rst, stats, rstats, safety, csr = args
+                bb = None
             prev_ll = hl.planes[kernels.HP_LEADERLESS]
             fargs = (st, crashed, append)
             if chaos_compiled is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
@@ -290,18 +304,30 @@ def make_cadence_runner(
             rst2 = rst._replace(
                 prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
             )
-            return (st2, hl2, rst2, stats2, rstats, safety, csr)
+            out = (st2, hl2, rst2, stats2, rstats, safety)
+            if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+                # Unreachable with the black box on (steady_mask rejects
+                # blackbox horizons, so pred is constant-false) but the
+                # cond still traces both branches: pass the recorder
+                # through untouched.
+                out = out + (bb,)
+            return out + (csr,)
 
         carry = jax.lax.cond(
-            pred, fast, general,
-            (st, hl, rst, stats, rstats, safety, csr),
+            pred, fast, general, inner0 + (csr,),
         )
         fused_rounds = jnp.where(
             pred, jnp.int32(rounds * cfg.n_groups), jnp.int32(0)
         )
         return carry + (fused_rounds,)
 
-    return jax.jit(run, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+    return jax.jit(
+        run,
+        donate_argnums=(
+            (0, 1, 2, 3, 4, 5, 6, 7) if cfg.blackbox else
+            (0, 1, 2, 3, 4, 5, 6)
+        ),
+    )
 
 
 class Autopilot:
@@ -693,6 +719,7 @@ class Autopilot:
         safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
         csr = jnp.int32(0)
         st = sim.state
+        bb = sim._blackbox
         transfer = np.zeros((G,), np.int32)
         kick = np.zeros((P, G), bool)
         done = 0
@@ -709,13 +736,23 @@ class Autopilot:
                 chaos_compiled.link_packed, chaos_compiled.loss_packed,
                 chaos_compiled.crashed_packed, chaos_compiled.append,
             )
-            st, hl, rst, stats, rstats, safety, csr, seg_fused = runner(
-                st, hl, rst, stats, rstats, safety, csr,
+            out = runner(
+                st, hl, rst, stats, rstats, safety,
+                *((bb,) if bb is not None else ()),
+                csr,
                 jnp.int32(done),
                 jnp.asarray(transfer, dtype=jnp.int32),
                 jnp.asarray(kick, dtype=bool),
                 *sched_args,
             )
+            if bb is not None:
+                (
+                    st, hl, rst, stats, rstats, safety, bb, csr,
+                    seg_fused,
+                ) = out
+                sim._blackbox = bb
+            else:
+                st, hl, rst, stats, rstats, safety, csr, seg_fused = out
             if self.fused:
                 # graftcheck: allow-no-host-sync-in-jit — one int32
                 # scalar per cadence segment, outside the jitted scans.
@@ -760,15 +797,31 @@ class Autopilot:
                 rst = init_reconfig_state(st)
         # Tail audit, exactly make_runner's: a final-round apply's mask
         # transition is checked one extra fold later.
-        safety = safety + kernels.check_safety(
-            st.state, st.term, st.commit, st.last_index, st.agree,
-            st.commit,
-            voter_mask=st.voter_mask,
-            outgoing_mask=st.outgoing_mask,
-            matched=st.matched,
-            prev_voter_mask=rst.prev_voter,
-            prev_outgoing_mask=rst.prev_outgoing,
-        )
+        if bb is not None:
+            viol = kernels.check_safety_groups(
+                st.state, st.term, st.commit, st.last_index, st.agree,
+                st.commit,
+                voter_mask=st.voter_mask,
+                outgoing_mask=st.outgoing_mask,
+                matched=st.matched,
+                prev_voter_mask=rst.prev_voter,
+                prev_outgoing_mask=rst.prev_outgoing,
+            )
+            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
+            meta, trip = kernels.blackbox_mark(
+                bb.meta, bb.trip_round, bb.round_idx, viol
+            )
+            sim._blackbox = bb._replace(meta=meta, trip_round=trip)
+        else:
+            safety = safety + kernels.check_safety(
+                st.state, st.term, st.commit, st.last_index, st.agree,
+                st.commit,
+                voter_mask=st.voter_mask,
+                outgoing_mask=st.outgoing_mask,
+                matched=st.matched,
+                prev_voter_mask=rst.prev_voter,
+                prev_outgoing_mask=rst.prev_outgoing,
+            )
         from .health import HealthMonitor
 
         # graftcheck: allow-no-host-sync-in-jit — end-of-run download of

@@ -433,32 +433,65 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
 
     Returns a callable (state, health) -> (state', health',
     stats[N_CHAOS_STATS], safety[N_SAFETY]); state and health are
-    donated, the schedule arrays are not (bench reps reuse them).  Build
-    once and call repeatedly — each make_runner call compiles afresh.
-    The underlying jit and its trailing schedule arguments are exposed
-    as ``runner.jitted`` / ``runner.schedule_args`` for the graftcheck
-    trace audit (tools/graftcheck/trace/inventory.py).
+    donated, the schedule arrays are not (bench reps reuse them).  With
+    SimConfig(blackbox=True) the signature gains a sim.BlackboxState —
+    (state, health, blackbox) -> (state', health', blackbox', stats,
+    safety) — and each round folds kernels.check_safety_groups instead,
+    summing the per-group indicators into the identical safety counts
+    while the black box records the offending (group, round) pairs; the
+    blackbox=False graph is byte-identical to the pre-forensics build.
+    Build once and call repeatedly — each make_runner call compiles
+    afresh.  The underlying jit and its trailing schedule arguments are
+    exposed as ``runner.jitted`` / ``runner.schedule_args`` for the
+    graftcheck trace audit (tools/graftcheck/trace/inventory.py).
     """
     n_rounds = compiled.n_rounds
+    with_bb = cfg.blackbox
 
     def body(carry, r, sched):
-        st, hl, stats, safety = carry
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            st, hl, bb, stats, safety = carry
+        else:
+            st, hl, stats, safety = carry
+            bb = None
         link, crashed, append = schedule_masks(sched, r)
         prev_leaderless = hl.planes[kernels.HP_LEADERLESS]
         st2, hl2 = sim_mod.step(
             cfg, st, crashed, append, health=hl, link=link
         )
-        safety = safety + kernels.check_safety(
-            st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
-            st.commit,
-        )
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            viol = kernels.check_safety_groups(
+                st2.state, st2.term, st2.commit, st2.last_index,
+                st2.agree, st.commit,
+            )
+            # dtype= keeps the slot sums int32 under x64 (GC007); the
+            # per-group sums equal check_safety's counts exactly
+            # (tests/test_forensics.py pins it).
+            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
+            bb = sim_mod.BlackboxState(*kernels.blackbox_fold(
+                bb.meta, bb.term, bb.commit, bb.trip_round, bb.round_idx,
+                st2.state, st2.term, st2.commit, crashed, viol,
+            ))
+        else:
+            safety = safety + kernels.check_safety(
+                st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
+                st.commit,
+            )
         stats = update_chaos_stats(
             stats, prev_leaderless, hl2.planes[kernels.HP_LEADERLESS]
         )
-        return (st2, hl2, stats, safety), ()
+        out = (
+            (st2, hl2, bb, stats, safety)
+            if with_bb
+            else (st2, hl2, stats, safety)
+        )
+        return out, ()
 
-    def run(st, hl, phase_of_round, link_packed, loss_packed,
-            crashed_packed, append):
+    def run(st, hl, *args):
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            bb, args = args[0], args[1:]
+        (phase_of_round, link_packed, loss_packed, crashed_packed,
+         append) = args
         sched = compiled._replace(
             phase_of_round=phase_of_round,
             link_packed=link_packed,
@@ -468,14 +501,21 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
         )
         stats = jnp.zeros((N_CHAOS_STATS,), jnp.int32)
         safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        carry = (
+            (st, hl, bb, stats, safety)
+            if with_bb
+            else (st, hl, stats, safety)
+        )
         carry, _ = jax.lax.scan(
             lambda c, r: body(c, r, sched),
-            (st, hl, stats, safety),
+            carry,
             jnp.arange(n_rounds, dtype=jnp.int32),
         )
         return carry
 
-    jitted = jax.jit(run, donate_argnums=(0, 1))
+    jitted = jax.jit(
+        run, donate_argnums=(0, 1, 2) if with_bb else (0, 1)
+    )
     schedule_args = (
         compiled.phase_of_round,
         compiled.link_packed,
@@ -484,8 +524,8 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
         compiled.append,
     )
 
-    def runner(st, hl):
-        return jitted(st, hl, *schedule_args)
+    def runner(st, hl, *bb):
+        return jitted(st, hl, *bb, *schedule_args)
 
     runner.jitted = jitted  # type: ignore[attr-defined]
     runner.schedule_args = schedule_args  # type: ignore[attr-defined]

@@ -210,6 +210,67 @@ def load_read_state(path: str):
     )
 
 
+_BLACKBOX_FORMAT_VERSION = 1
+
+# The persisted black-box planes, in BlackboxState field order: the ring
+# windows, the first-trip plane, and the absolute round counter — so a
+# post-mortem can be extracted from a crashed run's checkpoint exactly as
+# from the live sim (forensics.decode_window reads the same arrays).
+_BLACKBOX_FIELDS = ("meta", "term", "commit", "trip_round", "round_idx")
+
+
+def save_blackbox_state(blackbox, path: str) -> None:
+    """Atomically write the black-box flight recorder (ISSUE 15;
+    sim.BlackboxState) next to a SimState checkpoint, so the forensic
+    window survives the process that captured it."""
+    arrays = {
+        name: np.asarray(getattr(blackbox, name))
+        for name in _BLACKBOX_FIELDS
+    }
+    arrays["__blackbox_version__"] = np.asarray(_BLACKBOX_FORMAT_VERSION)
+    dir_ = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_blackbox_state(path: str):
+    """Load a black-box recorder written by save_blackbox_state; returns
+    a sim.BlackboxState.  Loud ValueError on a missing version marker, an
+    unsupported version, or a missing plane."""
+    from .sim import BlackboxState
+
+    with np.load(path) as data:
+        if "__blackbox_version__" not in data:
+            raise ValueError(
+                f"{path!r} is not a black-box checkpoint (missing "
+                "version marker — did you pass a SimState checkpoint?)"
+            )
+        version = int(data["__blackbox_version__"])
+        if version != _BLACKBOX_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported black-box checkpoint version {version}"
+            )
+        fields = {}
+        for name in _BLACKBOX_FIELDS:
+            if name not in data:
+                raise ValueError(
+                    f"black-box checkpoint {path!r} is missing plane "
+                    f"{name!r} (corrupt or truncated file)"
+                )
+            arr = data[name]
+            fields[name] = jnp.asarray(arr, dtype=arr.dtype)
+    return BlackboxState(**fields)
+
+
 def hard_states(state: SimState) -> Dict[str, np.ndarray]:
     """The durable per-peer raft state {term, vote, commit} (reference:
     proto/proto/eraftpb.proto:94-98), shaped [P, G]."""

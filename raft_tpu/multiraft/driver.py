@@ -403,7 +403,7 @@ class MultiRaft:
         if self.health_config is not None:
             out["mttr"] = self.mttr()
         if self.health_monitor is not None:
-            for entry in reversed(self.health_monitor.flight_recorder()):
+            for entry in reversed(self.health_monitor.summary_ring()):
                 if "autopilot" in entry:
                     out["last_run"] = entry["autopilot"]
                     break
@@ -467,6 +467,23 @@ class MultiRaft:
         }
         if self.metrics is not None:
             out["metrics"] = self.metrics_snapshot()
+        if self.health_monitor is not None:
+            # The forensics surface (ISSUE 15): incidents the attached
+            # monitor has recorded — from a device black box
+            # (ClusterSim's drain) or any other record_incident caller —
+            # summarized as cumulative per-slot counts plus the most
+            # recent incident, so an operator's status poll can never
+            # miss a tripped invariant.
+            incidents = self.health_monitor.incidents()
+            counts: Dict[str, int] = {}
+            for inc in incidents:
+                slot = inc.get("slot", "unknown")
+                counts[slot] = max(counts.get(slot, 0), inc.get("count", 0))
+            out["forensics"] = {
+                "incidents": len(incidents),
+                "counts": counts,
+                "last": incidents[-1] if incidents else None,
+            }
         return out
 
     def metrics_snapshot(self) -> Dict[str, float]:

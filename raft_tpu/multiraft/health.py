@@ -52,7 +52,15 @@ class HealthMonitor:
     ):
         self.metrics = metrics
         self.snapshot_fn = snapshot_fn
-        self._ring: Deque[dict] = deque(maxlen=recorder_size)
+        # The host SUMMARY ring: recent fixed-size summaries and scenario
+        # reports.  Deliberately distinct from the DEVICE black box
+        # (sim.BlackboxState, ISSUE 15) — this ring holds what already
+        # crossed to the host; the black box holds per-group round
+        # deltas that never leave the device until an incident drains.
+        self._summary_ring: Deque[dict] = deque(maxlen=recorder_size)
+        # Per-slot cumulative offender counts already counted into the
+        # incident metric (record_incident increments by the delta).
+        self._incident_seen: Dict[str, int] = {}
         self._seq = 0
         self._lock = threading.Lock()
 
@@ -89,7 +97,7 @@ class HealthMonitor:
             if snapshots:
                 entry["worst_snapshots"] = snapshots
             self._seq += 1
-            self._ring.append(entry)
+            self._summary_ring.append(entry)
         m = self.metrics
         if m is not None:
             m.on_health_summary(summary)
@@ -239,7 +247,7 @@ class HealthMonitor:
             entry = {"seq": self._seq, "ts": time.time(),
                      "reconfig": report}
             self._seq += 1
-            self._ring.append(entry)
+            self._summary_ring.append(entry)
         m = self.metrics
         if m is not None:
             stalled = report.get("reconfig_stalled_groups", 0)
@@ -272,7 +280,7 @@ class HealthMonitor:
             entry = {"seq": self._seq, "ts": time.time(),
                      "autopilot": report}
             self._seq += 1
-            self._ring.append(entry)
+            self._summary_ring.append(entry)
         m = self.metrics
         if m is not None:
             m.trace(
@@ -296,7 +304,7 @@ class HealthMonitor:
         with self._lock:
             entry = {"seq": self._seq, "ts": time.time(), "reads": report}
             self._seq += 1
-            self._ring.append(entry)
+            self._summary_ring.append(entry)
         m = self.metrics
         if m is not None:
             m.trace(
@@ -320,7 +328,7 @@ class HealthMonitor:
         with self._lock:
             entry = {"seq": self._seq, "ts": time.time(), "chaos": report}
             self._seq += 1
-            self._ring.append(entry)
+            self._summary_ring.append(entry)
         m = self.metrics
         if m is not None:
             m.trace(
@@ -336,16 +344,63 @@ class HealthMonitor:
                 m.trace("chaos.safety", **report["safety"])
         return entry
 
-    def last(self) -> Optional[dict]:
-        """Most recent flight-recorder entry, or None."""
+    def record_incident(self, incident: dict) -> dict:
+        """Fold a forensics incident (the ISSUE 15 device black-box
+        capture: {"slot": name, "count": n, "offenders": [{"group",
+        "round"}, ...]}) into the summary ring, emit the
+        `forensics.incident` trace event, and bump the
+        multiraft_safety_incidents_total{slot} counter by the NEW
+        offender count since the slot was last reported (the caller —
+        ClusterSim's drain — passes cumulative counts)."""
         with self._lock:
-            return self._ring[-1] if self._ring else None
+            entry = {"seq": self._seq, "ts": time.time(),
+                     "incident": incident}
+            self._seq += 1
+            self._summary_ring.append(entry)
+            # The seen-count read-modify-write shares the ring's lock:
+            # two concurrent reporters of the same slot must not both
+            # count the same offenders into the metric.
+            prev = self._incident_seen.get(incident["slot"], 0)
+            delta = max(0, incident.get("count", 0) - prev)
+            self._incident_seen[incident["slot"]] = max(
+                prev, incident.get("count", 0)
+            )
+        m = self.metrics
+        if m is not None:
+            if delta:
+                m.safety_incidents.labels(slot=incident["slot"]).inc(delta)
+            m.trace(
+                "forensics.incident",
+                slot=incident["slot"],
+                count=incident.get("count", 0),
+                offenders=incident.get("offenders", []),
+            )
+        return entry
+
+    def incidents(self) -> List[dict]:
+        """Oldest-to-newest forensics incidents recorded so far."""
+        with self._lock:
+            return [
+                e["incident"] for e in self._summary_ring if "incident" in e
+            ]
+
+    def last(self) -> Optional[dict]:
+        """Most recent summary-ring entry, or None."""
+        with self._lock:
+            return self._summary_ring[-1] if self._summary_ring else None
+
+    def summary_ring(self) -> List[dict]:
+        """Oldest-to-newest copy of the host summary ring."""
+        with self._lock:
+            return list(self._summary_ring)
 
     def flight_recorder(self) -> List[dict]:
-        """Oldest-to-newest copy of the recorder ring."""
-        with self._lock:
-            return list(self._ring)
+        """DEPRECATED alias for summary_ring(): the historical name now
+        belongs to the DEVICE black box (SimConfig.blackbox /
+        ClusterSim.forensics()); this host-side ring holds summaries and
+        scenario reports, not per-round flight data."""
+        return self.summary_ring()
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._ring)
+            return len(self._summary_ring)

@@ -119,6 +119,30 @@ here is missing from it or untested under tests/.
                                election-timeout boundary — per-round parity
                                vs real check-quorum Rafts in
                                tests/test_damping_parity.py
+  check_safety_groups      <-> the per-GROUP form of check_safety (same
+                               invariants, same optional args): the
+                               forensics trigger surface — its slot-wise
+                               group sums are asserted EQUAL to
+                               check_safety's counts on fuzzed and
+                               trapped states in tests/test_forensics.py
+  pack_blackbox_meta /     <-> the packed black-box ring word (role < 4,
+  unpack_blackbox_meta         acting leader id <= n_peers < 16, N_SAFETY
+                               fired-slot bits — GC008 PACKED_PLANES
+                               `blackbox_meta`); exact round-trip in
+                               tests/test_forensics.py
+  zero_blackbox /          <-> the device black-box flight recorder
+  blackbox_fold /              (ISSUE 15): a [W, G] windowed ring of
+  blackbox_mark                per-group round deltas plus the
+                               [N_SAFETY, G] first-trip round plane, one
+                               masked fold per round; the host twin is
+                               forensics.decode_window + the scalar
+                               replay in tests/test_forensics.py
+  blackbox_capture         <-> the drain-time reduction of the trip plane
+                               to fixed-size (counts, first-K offender
+                               ids, trip rounds) per safety slot —
+                               lax.top_k with the same low-group-id tie
+                               break as health_summary; host-argsort
+                               parity in tests/test_forensics.py
 
 TPU notes: P is tiny (<= 8 typical) and static, so the "sort" in
 committed_index is a fixed-width masked sort along the last axis that XLA
@@ -783,6 +807,145 @@ def check_safety(
     )
 
 
+def check_safety_groups(
+    state: jnp.ndarray,  # gc: int32[P, G]
+    term: jnp.ndarray,  # gc: int32[P, G]
+    commit: jnp.ndarray,  # gc: int32[P, G]
+    last_index: jnp.ndarray,  # gc: int32[P, G]
+    agree: jnp.ndarray,  # gc: int32[P, P, G]
+    prev_commit: jnp.ndarray,  # gc: int32[P, G]
+    voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    matched: Optional[jnp.ndarray] = None,  # gc: int32[P, P, G]
+    crashed: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    prev_voter_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    prev_outgoing_mask: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    lease_holder: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
+    lease_fire: Optional[jnp.ndarray] = None,  # gc: bool[G]
+) -> jnp.ndarray:
+    """The per-GROUP form of `check_safety` (ISSUE 15): the identical
+    invariants over the identical optional-argument matrix, returning the
+    bool[N_SAFETY, G] violation indicators INSTEAD of their group sums —
+    the black-box trigger surface, which needs to know WHICH groups
+    tripped, not just how many.
+
+    `check_safety` stays the separate, pinned aggregate kernel (its
+    traced graph anchors every flag-off jaxpr budget); this function is
+    deliberately a standalone twin rather than its factored core, and the
+    drift risk that buys is machine-closed by tests/test_forensics.py,
+    which asserts `check_safety_groups(...).sum(axis=-1) ==
+    check_safety(...)` slot-for-slot on fuzzed, joint, leased, and
+    trapped states every round it drives.
+    """
+    P = state.shape[0]
+    G = state.shape[1]
+    off_diag = ~jnp.eye(P, dtype=bool)[:, :, None]
+    is_lead = state == ROLE_LEADER
+    dual = (
+        is_lead[:, None, :]
+        & is_lead[None, :, :]
+        & (term[:, None, :] == term[None, :, :])
+        & off_diag
+    )
+    cmin = jnp.minimum(commit[:, None, :], commit[None, :, :])
+    diverged = (cmin > agree) & off_diag
+    regressed = commit < prev_commit
+    lmin = jnp.minimum(last_index[:, None, :], last_index[None, :, :])
+    invalid = ((agree > lmin) & off_diag) | (commit > last_index)[:, None, :]
+    zero_g = jnp.zeros((G,), bool)
+    if voter_mask is not None:
+        if outgoing_mask is None or matched is None:
+            raise ValueError(
+                "joint-window checks need voter_mask, outgoing_mask AND "
+                "matched together"
+            )
+        non_follower = state != ROLE_FOLLOWER
+        outside = non_follower & ~(voter_mask | outgoing_mask)
+        g_outside = jnp.any(outside, axis=0)
+        alive = (
+            ~crashed if crashed is not None else jnp.ones_like(is_lead)
+        )
+        lead_alive = is_lead & alive
+        max_alive_term = jnp.max(jnp.where(lead_alive, term, -1), axis=0)
+        checked = is_lead & (~alive | (term == max_alive_term[None, :]))
+        owner_rows = jnp.swapaxes(matched, 1, 2)
+        mci = jnp.minimum(
+            committed_index(
+                owner_rows,
+                jnp.broadcast_to(
+                    jnp.swapaxes(voter_mask, 0, 1)[None, :, :],
+                    owner_rows.shape,
+                ),
+            ),
+            committed_index(
+                owner_rows,
+                jnp.broadcast_to(
+                    jnp.swapaxes(outgoing_mask, 0, 1)[None, :, :],
+                    owner_rows.shape,
+                ),
+            ),
+        )
+        prev_high = jnp.max(prev_commit, axis=0)
+        unbacked = (
+            checked & (commit > prev_high[None, :]) & (commit > mci)
+        )
+        g_unbacked = jnp.any(unbacked, axis=0)
+    else:
+        g_outside = zero_g
+        g_unbacked = zero_g
+    if prev_voter_mask is not None:
+        if voter_mask is None or prev_outgoing_mask is None:
+            raise ValueError(
+                "the double-change check needs prev AND current masks"
+            )
+        was_j = jnp.any(prev_outgoing_mask, axis=0)
+        now_j = jnp.any(outgoing_mask, axis=0)
+        vm_delta = jnp.sum(
+            prev_voter_mask ^ voter_mask, axis=0, dtype=jnp.int32
+        )
+        om_moved = jnp.any(prev_outgoing_mask ^ outgoing_mask, axis=0)
+        enter_bad = (~was_j & now_j) & jnp.any(
+            outgoing_mask ^ prev_voter_mask, axis=0
+        )
+        leave_bad = (was_j & ~now_j) & (vm_delta > 0)
+        stay_bad = (was_j & now_j) & ((vm_delta > 0) | om_moved)
+        simple_bad = (~was_j & ~now_j) & (vm_delta > 1)
+        g_double = enter_bad | leave_bad | stay_bad | simple_bad
+    else:
+        g_double = zero_g
+    if lease_holder is not None:
+        g_dual_lease = (
+            jnp.sum(lease_holder, axis=0, dtype=jnp.int32) >= 2
+        )
+        if lease_fire is not None:
+            fleet_high = jnp.max(prev_commit, axis=0)
+            stale = lease_holder & (prev_commit < fleet_high[None, :])
+            g_stale = lease_fire & jnp.any(stale, axis=0)
+        else:
+            g_stale = zero_g
+    else:
+        if lease_fire is not None:
+            raise ValueError(
+                "the stale-read check needs lease_holder alongside "
+                "lease_fire"
+            )
+        g_dual_lease = zero_g
+        g_stale = zero_g
+    return jnp.stack(
+        [
+            jnp.any(dual, axis=(0, 1)),
+            jnp.any(diverged, axis=(0, 1)),
+            jnp.any(regressed, axis=0),
+            jnp.any(invalid, axis=(0, 1)),
+            g_outside,
+            g_unbacked,
+            g_double,
+            g_stale,
+            g_dual_lease,
+        ]
+    )
+
+
 def apply_confchange(
     state: jnp.ndarray,  # gc: int32[P, G]
     leader_id: jnp.ndarray,  # gc: int32[P, G]
@@ -1295,6 +1458,170 @@ def health_summary(
         hist,
         worst_ids.astype(jnp.int32),
         worst_scores.astype(jnp.int32),
+    )
+
+
+# --- device-side black-box flight recorder (the forensics layer) ---------
+#
+# ISSUE 15: a bit-packed, [W, G]-windowed trace of per-group round deltas
+# plus a first-trip capture plane, carried through the jitted scans behind
+# SimConfig(blackbox=True) so a safety counter firing at fleet scale can
+# be drilled down to the offending GROUP and ROUND without re-running
+# anything.  One masked fold per round, zero host syncs; the fixed-size
+# blackbox_capture reduction is the only thing that ever crosses to the
+# host (the drain cadence, like health_summary).
+#
+# Ring word layout (GC008 PACKED_PLANES `blackbox_meta`, bound derivation
+# in docs/STATIC_ANALYSIS.md "Black-box planes"):
+#   bits 0-1   group max ROLE_* code (< 4)
+#   bits 2-5   acting leader peer id (kernels.acting_leader_id,
+#              0..n_peers <= 8 < 16)
+#   bits 6-14  the N_SAFETY fired-slot indicators for the round
+BB_LEADER_SHIFT = 2
+BB_SAFETY_SHIFT = 6
+BB_META_BITS = BB_SAFETY_SHIFT + N_SAFETY  # 15 of 32 word bits used
+
+
+def pack_blackbox_meta(
+    role: jnp.ndarray,  # gc: int32[...]
+    leader_id: jnp.ndarray,  # gc: int32[...]
+    safety_bits: jnp.ndarray,  # gc: uint32[...]
+) -> jnp.ndarray:
+    """Pack one black-box ring record into its uint32 word (layout above);
+    all three fields are provably sub-field-width (GC008 PACKED_PLANES
+    `blackbox_meta`) so the word is lossless by construction."""
+    return (
+        role.astype(jnp.uint32)
+        | (leader_id.astype(jnp.uint32) << BB_LEADER_SHIFT)
+        | (safety_bits.astype(jnp.uint32) << BB_SAFETY_SHIFT)
+    )
+
+
+def unpack_blackbox_meta(
+    word: jnp.ndarray,  # gc: uint32[...]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Inverse of pack_blackbox_meta: word -> (role, leader_id,
+    safety_bits)."""
+    role = (word & jnp.uint32(3)).astype(jnp.int32)
+    leader = ((word >> BB_LEADER_SHIFT) & jnp.uint32(0xF)).astype(jnp.int32)
+    bits = (word >> BB_SAFETY_SHIFT) & jnp.uint32((1 << N_SAFETY) - 1)
+    return role, leader, bits
+
+
+def zero_blackbox(
+    n_groups: int, window: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fresh black-box planes: (meta uint32[W, G], term int32[W, G],
+    commit int32[W, G], trip_round int32[N_SAFETY, G] at INF = never
+    tripped, round_idx int32[] = 0).  sim.BlackboxState is the carried
+    pytree form."""
+    return (
+        jnp.zeros((window, n_groups), jnp.uint32),
+        jnp.zeros((window, n_groups), jnp.int32),
+        jnp.zeros((window, n_groups), jnp.int32),
+        jnp.full((N_SAFETY, n_groups), INF, jnp.int32),
+        jnp.int32(0),
+    )
+
+
+def blackbox_fold(
+    meta_ring: jnp.ndarray,  # gc: uint32[W, G]
+    term_ring: jnp.ndarray,  # gc: int32[W, G]
+    commit_ring: jnp.ndarray,  # gc: int32[W, G]
+    trip_round: jnp.ndarray,  # gc: int32[S, G]
+    round_idx: jnp.ndarray,  # gc: int32[]
+    state: jnp.ndarray,  # gc: int32[P, G]
+    term: jnp.ndarray,  # gc: int32[P, G]
+    commit: jnp.ndarray,  # gc: int32[P, G]
+    crashed: jnp.ndarray,  # gc: bool[P, G]
+    viol: jnp.ndarray,  # gc: bool[S, G]
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fold one round's per-group deltas into the black-box ring: write
+    slot round_idx % W with (packed role|leader|safety-bits word, group
+    max term, group max commit) and min-fold this round into the
+    first-trip plane where `viol` fired.  Purely elementwise along G plus
+    one W-row dynamic write — shard-trivial on a group-sharded mesh, zero
+    collectives (the GC015 steady-graph discipline).
+
+    `viol` is kernels.check_safety_groups' output for the round; callers
+    without a safety audit in the loop (the plain run_compiled trace)
+    pass all-False and get the trace ring alone — `blackbox_mark` can
+    stamp the bits in later from the same round index.
+    """
+    W = meta_ring.shape[0]
+    role = jnp.max(state, axis=0)  # 2-bit ROLE_* summary (max code)
+    lead = acting_leader_id(state, term, crashed)
+    lanes = jnp.arange(N_SAFETY, dtype=jnp.uint32)[:, None]
+    # Bits are disjoint, so the shifted sum is a bitwise OR; dtype= keeps
+    # the reduction uint32 under x64 (GC007).
+    bits = jnp.sum(
+        viol.astype(jnp.uint32) << lanes, axis=0, dtype=jnp.uint32
+    )
+    word = pack_blackbox_meta(role, lead, bits)
+    slot = round_idx % jnp.int32(W)
+    meta_ring = meta_ring.at[slot].set(word)
+    term_ring = term_ring.at[slot].set(jnp.max(term, axis=0))
+    commit_ring = commit_ring.at[slot].set(jnp.max(commit, axis=0))
+    trip_round = jnp.minimum(
+        trip_round, jnp.where(viol, round_idx, INF)
+    )
+    return meta_ring, term_ring, commit_ring, trip_round, round_idx + 1
+
+
+def blackbox_mark(
+    meta_ring: jnp.ndarray,  # gc: uint32[W, G]
+    trip_round: jnp.ndarray,  # gc: int32[S, G]
+    round_idx: jnp.ndarray,  # gc: int32[]
+    viol: jnp.ndarray,  # gc: bool[S, G]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stamp a violation mask onto the LAST folded round (round_idx - 1):
+    OR the fired-slot bits into its ring word and min-fold the trip
+    plane.  The ad-hoc stepping path (ClusterSim.run_round + a host-side
+    safety audit between rounds) uses this; the compiled runners fold
+    bits and trace in one blackbox_fold call instead.  A mark on a FRESH
+    recorder (round_idx == 0: no round has been folded, so there is
+    nothing to attribute to) is a no-op — the mask is masked off rather
+    than stamping round -1 onto ring slot W-1."""
+    W = meta_ring.shape[0]
+    viol = viol & (round_idx > 0)
+    r = jnp.maximum(round_idx - 1, 0)
+    slot = r % jnp.int32(W)
+    lanes = jnp.arange(N_SAFETY, dtype=jnp.uint32)[:, None]
+    bits = jnp.sum(
+        viol.astype(jnp.uint32) << lanes, axis=0, dtype=jnp.uint32
+    )
+    meta_ring = meta_ring.at[slot].set(
+        meta_ring[slot] | (bits << jnp.uint32(BB_SAFETY_SHIFT))
+    )
+    trip_round = jnp.minimum(trip_round, jnp.where(viol, r, INF))
+    return meta_ring, trip_round
+
+
+def blackbox_capture(
+    trip_round: jnp.ndarray,  # gc: int32[S, G]
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Drain-time reduction of the first-trip plane to a fixed-size
+    capture: (counts int32[N_SAFETY], ids int32[N_SAFETY, k], rounds
+    int32[N_SAFETY, k]) — per safety slot, how many groups ever tripped
+    it and the FIRST k offenders in (trip round, group id) order
+    (first-K-stable: `jax.lax.top_k` on the negated trip rounds breaks
+    ties toward the LOWER group id, exactly like health_summary's
+    worst-offender extraction).  Unfired lanes carry id/round -1.  O(k)
+    bytes across the host boundary regardless of G; on a group-sharded
+    mesh the top_k gathers per-shard candidates once per drain cadence —
+    the same registered-gather shape as the sharded health drain, never
+    in the hot loop."""
+    fired = trip_round < INF
+    # dtype= keeps the counts int32 under x64 (GC007).
+    counts = jnp.sum(fired, axis=1, dtype=jnp.int32)
+    neg, ids = jax.lax.top_k(-trip_round, k)
+    rounds = -neg
+    got = rounds < INF
+    return (
+        counts,
+        jnp.where(got, ids.astype(jnp.int32), -1),
+        jnp.where(got, rounds, -1),
     )
 
 

@@ -1418,6 +1418,13 @@ def steady_mask(
     (workload.make_split_runner; fused-vs-general bit-parity in
     tests/test_workload.py).  None keeps every existing graph
     unchanged."""
+    if cfg.blackbox:
+        # Conservative v1 (ISSUE 15): the fused kernel cannot fold the
+        # black-box ring (the per-round trace write is wave-path logic),
+        # so an instrumented-forensics config rejects every fused horizon
+        # and rides the general path; bench.py --blackbox measures the
+        # cost, and the blackbox=False graphs here are untouched.
+        return jnp.zeros((cfg.n_groups,), bool)
     damped = cfg.check_quorum or cfg.pre_vote
     if damped and cfg.election_tick <= cfg.heartbeat_tick:
         # The check-quorum saturation argument needs one full heartbeat

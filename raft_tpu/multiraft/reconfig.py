@@ -844,10 +844,23 @@ def _runner_body(
     into the on-device histogram, and runs kernels.check_safety's
     linearizability slots (lease-holder mask off the round-ENTRY state)
     alongside the joint-window audit.  None keeps every historical graph
-    byte-identical."""
+    byte-identical.
+
+    Black-box forensics (ISSUE 15, SimConfig.blackbox): the carry gains
+    a TRAILING sim.BlackboxState; each round folds
+    kernels.check_safety_groups instead of check_safety — summing the
+    per-group indicators into the IDENTICAL safety counts
+    (tests/test_forensics.py pins the slot-for-slot equality) — and
+    records the post-round trace plus the fired (group, round) pairs in
+    one kernels.blackbox_fold.  blackbox=False keeps every historical
+    graph byte-identical."""
     P, G = cfg.n_peers, cfg.n_groups
+    with_bb = cfg.blackbox
 
     def body(carry, r):
+        bb = None
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            carry, bb = carry[:-1], carry[-1]
         rcar = rdstats = lat_hist = None
         if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             carry, (rcar, rdstats, lat_hist) = carry[:-3], carry[-3:]
@@ -951,18 +964,36 @@ def _runner_body(
         # state under the masks that governed the step; the mask
         # TRANSITION pair (prev round's step masks -> this round's) audits
         # the previous round's apply.
-        safety = safety + kernels.check_safety(
-            st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
-            st.commit,
-            voter_mask=st2.voter_mask,
-            outgoing_mask=st2.outgoing_mask,
-            matched=st2.matched,
-            crashed=crashed,
-            prev_voter_mask=rst.prev_voter,
-            prev_outgoing_mask=rst.prev_outgoing,
-            lease_holder=lease_holder,
-            lease_fire=lease_fire,
-        )
+        viol = None
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            viol = kernels.check_safety_groups(
+                st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
+                st.commit,
+                voter_mask=st2.voter_mask,
+                outgoing_mask=st2.outgoing_mask,
+                matched=st2.matched,
+                crashed=crashed,
+                prev_voter_mask=rst.prev_voter,
+                prev_outgoing_mask=rst.prev_outgoing,
+                lease_holder=lease_holder,
+                lease_fire=lease_fire,
+            )
+            # dtype= keeps the slot sums int32 under x64 (GC007); the
+            # per-group sums equal check_safety's counts exactly.
+            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
+        else:
+            safety = safety + kernels.check_safety(
+                st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
+                st.commit,
+                voter_mask=st2.voter_mask,
+                outgoing_mask=st2.outgoing_mask,
+                matched=st2.matched,
+                crashed=crashed,
+                prev_voter_mask=rst.prev_voter,
+                prev_outgoing_mask=rst.prev_outgoing,
+                lease_holder=lease_holder,
+                lease_fire=lease_fire,
+            )
         # The gated swap: target masks of the op being applied, the
         # reference's apply-time reactions on the batched planes.
         (
@@ -1038,6 +1069,15 @@ def _runner_body(
                 pending_since=jnp.where(served, 0, psince),
             )
             out = out + (rcar, rdstats, lat_hist)
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            # The ring records the round-EXIT (post-apply) state; the
+            # fired bits come from the audit above, so one fold covers
+            # trace and trigger capture.
+            bb = sim_mod.BlackboxState(*kernels.blackbox_fold(
+                bb.meta, bb.term, bb.commit, bb.trip_round, bb.round_idx,
+                st3.state, st3.term, st3.commit, crashed, viol,
+            ))
+            out = out + (bb,)
         return out, ()
 
     return body
@@ -1069,26 +1109,56 @@ def make_runner(
     n_rounds = compiled.n_rounds
     _validate_plans(cfg, compiled, chaos_compiled)
 
+    with_bb = cfg.blackbox
+
     def body(carry, r, sched, chaos_sched):
         return _runner_body(cfg, sched, chaos_sched)(carry, r)
 
-    def run(st, hl, rst, *sched_args):
+    def run(st, hl, rst, *args):
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            bb, sched_args = args[0], args[1:]
+        else:
+            sched_args = args
         sched, chaos_sched = _rebuild_scheds(
             compiled, chaos_compiled, sched_args
         )
         stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
         rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
         safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
+        carry = (st, hl, rst, stats, rstats, safety)
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            carry = carry + (bb,)
         carry, _ = jax.lax.scan(
             lambda c, r: body(c, r, sched, chaos_sched),
-            (st, hl, rst, stats, rstats, safety),
+            carry,
             jnp.arange(n_rounds, dtype=jnp.int32),
         )
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            carry, bb = carry[:-1], carry[-1]
         stf, hlf, rstf, stats, rstats, safety = carry
         # Tail audit: the scan body checks each apply's mask transition
         # one round later, so a final-round apply needs this one extra
         # fold (prev_commit = final commit keeps the commit checks inert
         # — only the transition + election-safety slots can fire).
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            viol = kernels.check_safety_groups(
+                stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+                stf.commit,
+                voter_mask=stf.voter_mask,
+                outgoing_mask=stf.outgoing_mask,
+                matched=stf.matched,
+                prev_voter_mask=rstf.prev_voter,
+                prev_outgoing_mask=rstf.prev_outgoing,
+            )
+            # dtype= keeps the slot sums int32 under x64 (GC007).
+            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
+            # The tail transition belongs to the LAST real round:
+            # blackbox_mark stamps slot round_idx - 1.
+            meta, trip = kernels.blackbox_mark(
+                bb.meta, bb.trip_round, bb.round_idx, viol
+            )
+            bb = bb._replace(meta=meta, trip_round=trip)
+            return stf, hlf, rstf, stats, rstats, safety, bb
         safety = safety + kernels.check_safety(
             stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
             stf.commit,
@@ -1100,7 +1170,9 @@ def make_runner(
         )
         return stf, hlf, rstf, stats, rstats, safety
 
-    jitted = jax.jit(run, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(
+        run, donate_argnums=(0, 1, 2, 3) if with_bb else (0, 1, 2)
+    )
     schedule_args = (
         compiled.phase_of_round, compiled.append, compiled.op_start,
         compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
@@ -1115,8 +1187,8 @@ def make_runner(
         else ()
     )
 
-    def runner(st, hl, rst):
-        return jitted(st, hl, rst, *schedule_args)
+    def runner(st, hl, rst, *bb):
+        return jitted(st, hl, rst, *bb, *schedule_args)
 
     runner.jitted = jitted  # type: ignore[attr-defined]
     runner.schedule_args = schedule_args  # type: ignore[attr-defined]
@@ -1187,6 +1259,13 @@ def make_split_runner(
             "make_split_runner needs SimConfig(collect_health=True) — the "
             "MTTR stats and the fused block's closed-form fold ride on the "
             "health planes"
+        )
+    if cfg.blackbox:
+        raise ValueError(
+            "make_split_runner does not thread the black box (v1: "
+            "steady_mask rejects blackbox-on horizons, so nothing would "
+            "fuse) — use make_runner; ClusterSim.run_reconfig(split=True) "
+            "falls back automatically"
         )
     if k > cfg.health_window:
         raise ValueError(

@@ -141,6 +141,32 @@ def shard_health(health, mesh: Mesh, axis: str = "groups"):
     return jax.tree.map(jax.device_put, health, health_sharding(mesh, axis))
 
 
+def blackbox_sharding(mesh: Mesh, axis: str = "groups"):
+    """NamedShardings for the BlackboxState pytree (ISSUE 15): every
+    plane is group-minor — the [W, G] ring rows and the [N_SAFETY, G]
+    first-trip plane shard on their last axis, the round counter is
+    replicated.  The per-round fold (kernels.blackbox_fold) is purely
+    elementwise along G plus a replicated-axis ring write, so the steady
+    sharded graphs stay collective-free; only the drain-cadence
+    kernels.blackbox_capture top_k gathers per-shard candidates — the
+    same registered-gather shape as the sharded health drain."""
+    from .sim import BlackboxState
+
+    xg = NamedSharding(mesh, P(None, axis))
+    return BlackboxState(
+        meta=xg, term=xg, commit=xg, trip_round=xg,
+        round_idx=NamedSharding(mesh, P()),
+    )
+
+
+def shard_blackbox(blackbox, mesh: Mesh, axis: str = "groups"):
+    """Place a BlackboxState on the mesh (device_put mirror of
+    shard_state)."""
+    return jax.tree.map(
+        jax.device_put, blackbox, blackbox_sharding(mesh, axis)
+    )
+
+
 def chaos_sharding(mesh: Mesh, axis: str = "groups"):
     """NamedShardings for a compiled chaos schedule (chaos.CompiledChaos):
     every packed per-phase plane is group-minor ([NPH, W, G] — the packed

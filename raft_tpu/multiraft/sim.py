@@ -75,6 +75,15 @@ Protocol scope (BASELINE configs 2/3/4/5 + the read barrier):
     `step(..., campaign_kick=)` is the companion admin action (MsgHup
     at tick time — RawNode::campaign).  Both are the autopilot's
     actuation surface (raft_tpu/multiraft/autopilot.py).
+  * black-box forensics (ISSUE 15): SimConfig(blackbox=True) carries the
+    device flight recorder (BlackboxState) — a [W, G] bit-packed ring of
+    per-group round deltas plus the [N_SAFETY, G] first-trip plane the
+    compiled runners min-fold from kernels.check_safety_groups — so a
+    nonzero safety count resolves to (group, round) offenders
+    (ClusterSim.forensics() / incident_report()), and
+    raft_tpu/multiraft/forensics.py turns a captured offender into a
+    one-group scalar repro.  Flag-off pytrees and graphs are
+    bit-identical, like every optional plane.
   Not modeled on device (host path handles them): snapshots and entry
   payloads (the device sees cursor effects only) and ad-hoc conf changes
   OUTSIDE a compiled plan — a manual host-side mask swap still works but
@@ -170,6 +179,26 @@ class SimConfig(NamedTuple):
     # bit-identical regardless, and no new SimState plane exists (the
     # lease gate reads the ISSUE 7 planes).
     lease_read: bool = False
+    # Black-box forensics (ISSUE 15): when True, ClusterSim carries the
+    # device-resident flight recorder (sim.BlackboxState) — a
+    # [blackbox_window, G] bit-packed ring of per-group round deltas
+    # (max role, acting leader id, max term, max commit, fired safety
+    # slots; kernels.blackbox_fold) plus the [N_SAFETY, G] first-trip
+    # plane the compiled runners min-fold from
+    # kernels.check_safety_groups — so a nonzero safety counter at fleet
+    # scale resolves to the offending (group, round) pairs without
+    # re-running anything.  One masked fold per round, zero host syncs;
+    # only the fixed-size kernels.blackbox_capture reduction crosses at
+    # the drain cadence.  Trace-time static like every plane flag: the
+    # blackbox=False pytrees and graphs are bit-identical to the
+    # pre-forensics build, and pallas_step.steady_mask conservatively
+    # rejects blackbox-on fused horizons (v1: the fused kernel cannot
+    # fold the ring), so instrumented runs ride the general path.
+    blackbox: bool = False
+    # Ring window W (rounds of per-group trace retained) and the
+    # first-K offender capture width per safety slot (blackbox_capture).
+    blackbox_window: int = 8
+    blackbox_topk: int = 8
     # SPMD/mesh-friendly graphs (ISSUE 14): when True, the plain step runs
     # its election phase UNCONDITIONALLY as masked ops instead of behind
     # `lax.cond(jnp.any(want_campaign & alive))`.  The cond's scalar
@@ -276,6 +305,38 @@ def init_health(cfg: SimConfig) -> HealthState:
         planes=kernels.zero_health(cfg.n_groups),
         window_pos=jnp.int32(0),
     )
+
+
+class BlackboxState(NamedTuple):
+    """Device-resident black-box flight recorder (ISSUE 15), carried
+    alongside SimState when SimConfig.blackbox is on.
+
+    meta:       uint32[W, G] packed per-round record ring (W =
+                SimConfig.blackbox_window; slot = round % W): group max
+                role, acting leader id, and the round's fired safety-slot
+                bits in one word (kernels.pack_blackbox_meta — GC008
+                PACKED_PLANES `blackbox_meta`).
+    term:       int32[W, G] group max term per ring slot.
+    commit:     int32[W, G] group max commit per ring slot.
+    trip_round: int32[kernels.N_SAFETY, G] FIRST round each safety slot
+                fired for each group (kernels.INF = never): the capture
+                plane kernels.blackbox_capture reduces to the fixed-size
+                per-slot offender lists at the drain cadence.
+    round_idx:  int32[] absolute rounds folded so far.
+    """
+
+    meta: jnp.ndarray  # gc: uint32[W, G]
+    term: jnp.ndarray  # gc: int32[W, G]
+    commit: jnp.ndarray  # gc: int32[W, G]
+    trip_round: jnp.ndarray  # gc: int32[S, G]
+    round_idx: jnp.ndarray  # gc: int32[]
+
+
+def init_blackbox(cfg: SimConfig) -> BlackboxState:
+    """Fresh (all-zero ring, never-tripped) black-box state."""
+    return BlackboxState(*kernels.zero_blackbox(
+        cfg.n_groups, cfg.blackbox_window
+    ))
 
 
 class ReconfigProposal(NamedTuple):
@@ -1009,6 +1070,7 @@ def step(
     transfer_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
     campaign_kick: Optional[jnp.ndarray] = None,  # gc: bool[P, G]
     read_propose: Optional[jnp.ndarray] = None,  # gc: int32[G]
+    blackbox: Optional[BlackboxState] = None,  # gc: BlackboxState
 ) -> Union[SimState, Tuple]:
     """One lockstep protocol round for every group.
 
@@ -1045,13 +1107,23 @@ def step(
     ReadReceipt extra.  Reads are pure probes: the round's protocol
     phases are unchanged by them.
 
+    blackbox: optional BlackboxState (ISSUE 15) — this round's per-group
+    deltas (max role, acting leader, max term, max commit) are folded
+    into the ring on-device (kernels.blackbox_fold, computed on the
+    round-EXIT state).  The step itself runs no safety audit, so the
+    fired-slot bits are folded as zero here; a caller auditing between
+    rounds stamps them onto the same slot with kernels.blackbox_mark,
+    and the compiled runners fold bits and trace in one call instead.
+
     Extras are appended to the return value in (counters, health,
-    proposal, read) order for whichever are given — (state,),
+    blackbox, proposal, read) order for whichever are given — (state,),
     (state, counters), (state, health), (state, counters, health), each
-    with the ReconfigProposal appended when reconfig_propose is given and
-    the ReadReceipt when read_propose is given; bare `state` when none.
-    All choices are trace-time static: the counters=None/health=None/
-    reconfig_propose=None/read_propose=None graph is unchanged.
+    with the BlackboxState appended after the health extra when
+    `blackbox` is given, the ReconfigProposal appended when
+    reconfig_propose is given and the ReadReceipt when read_propose is
+    given; bare `state` when none.  All choices are trace-time static:
+    the counters=None/health=None/blackbox=None/reconfig_propose=None/
+    read_propose=None graph is unchanged.
 
     The round = the scalar oracle's (tick all peers) + (pump to quiescence)
     + (propose at leader) + (pump), expressed as masked phases; the election
@@ -1063,6 +1135,36 @@ def step(
     replay expresses; with both flags False this dispatch (and the traced
     graph) is unchanged.
     """
+    if blackbox is not None:
+        # The black-box fold wraps whichever step path runs: the inner
+        # round is traced UNCHANGED (the blackbox=None graph is the
+        # pinned one) and the ring write folds on its exit state.  The
+        # step runs no safety audit, so the fired-slot bits fold as
+        # all-False here — kernels.blackbox_mark stamps them afterwards
+        # on the ad-hoc path; compiled runners bypass this wrapper and
+        # fold bits + trace in one kernels.blackbox_fold call.
+        res = step(
+            cfg, st, crashed, append_n, group_ids, counters, health, link,
+            reconfig_propose, transfer_propose, campaign_kick,
+            read_propose,
+        )
+        if isinstance(res, SimState):  # graftcheck: allow-no-python-branch-on-traced — pytree STRUCTURE test (trace-time static), not a value branch
+            res = (res,)
+        st_out = res[0]
+        no_viol = jnp.zeros(
+            (kernels.N_SAFETY, cfg.n_groups), bool
+        )
+        bb = BlackboxState(*kernels.blackbox_fold(
+            blackbox.meta, blackbox.term, blackbox.commit,
+            blackbox.trip_round, blackbox.round_idx,
+            st_out.state, st_out.term, st_out.commit, crashed, no_viol,
+        ))
+        pos = (
+            1
+            + (1 if counters is not None else 0)
+            + (1 if health is not None else 0)
+        )
+        return res[:pos] + (bb,) + res[pos:]
     if transfer_propose is not None and st.transferee is None:
         raise ValueError(
             "step(transfer_propose=) needs the lead_transferee plane — "
@@ -3673,6 +3775,38 @@ class ClusterSim:
                     )
 
                 self._step_both = jax.jit(_both, donate_argnums=(0, 3, 4))
+        # Black-box forensics (ISSUE 15): the device flight recorder and
+        # its fixed-size drain reduction.  The blackbox-off construction
+        # above is untouched — every pre-existing wrapper and its pinned
+        # graph stays byte-identical.
+        self._blackbox: Optional[BlackboxState] = None
+        if cfg.blackbox:
+            self._blackbox = init_blackbox(cfg)
+            if mesh is not None:
+                from . import sharding as sharding_mod
+
+                self._blackbox = sharding_mod.shard_blackbox(
+                    self._blackbox, mesh, mesh_axis
+                )
+            bbk = min(cfg.blackbox_topk, cfg.n_groups)
+            self._bb_capture = jax.jit(
+                functools.partial(kernels.blackbox_capture, k=bbk)
+            )
+            self._bb_mark = jax.jit(kernels.blackbox_mark)
+            # Per-slot offender counts already surfaced through the
+            # monitor (so a drain reports each incident once).
+            self._bb_seen = [0] * kernels.N_SAFETY
+
+            def _bb_step(st, crashed, append_n, ctrs, health, bb,
+                         link=None):
+                return step(
+                    cfg, st, crashed, append_n, counters=ctrs,
+                    health=health, link=link, blackbox=bb,
+                )
+
+            self._step_blackbox = jax.jit(
+                _bb_step, donate_argnums=(0, 3, 4, 5)
+            )
 
     _DRAIN_MAX = 128  # never let a window exceed this many rounds
 
@@ -3721,6 +3855,12 @@ class ClusterSim:
             self._counters = self._put_replicated(kernels.zero_counters())
         if self._health is not None and self.health_monitor is not None:
             bufs["summary"] = self._summary_fn(self._health.planes)
+        if self._blackbox is not None and self.health_monitor is not None:
+            # The fixed-size forensics capture (counts + first-K offender
+            # ids per safety slot) dispatches device-side here; the
+            # incident check happens host-side in _settle_drain, so the
+            # transfer overlaps the next scan segment like every drain.
+            bufs["forensics"] = self._bb_capture(self._blackbox.trip_round)
         self._rounds_since_drain = 0
         return bufs
 
@@ -3763,6 +3903,25 @@ class ClusterSim:
             self.health_monitor.record(
                 HealthMonitor.summary_dict(counts, hist, ids, scores)
             )
+        capture = bufs.get("forensics")
+        if capture is not None:
+            # graftcheck: allow-no-host-sync-in-jit — the FIXED-SIZE
+            # forensics capture ([N_SAFETY] counts + [N_SAFETY, K] ids),
+            # same drain overlap as the summary above.
+            bcounts, bids, brounds = jax.device_get(capture)
+            for s in range(kernels.N_SAFETY):
+                n = int(bcounts[s])
+                if n > self._bb_seen[s]:
+                    self._bb_seen[s] = n
+                    self.health_monitor.record_incident({
+                        "slot": kernels.SAFETY_NAMES[s],
+                        "count": n,
+                        "offenders": [
+                            {"group": int(g), "round": int(r)}
+                            for g, r in zip(bids[s], brounds[s])
+                            if g >= 0
+                        ],
+                    })
 
     def _drain_counters(self) -> None:
         """Blocking counter drain (run_round cadence / counters() reads)."""
@@ -3793,7 +3952,26 @@ class ClusterSim:
             crashed, append_n, link
         )
         cc, ch = self._counters is not None, self._health is not None
-        if cc and ch:
+        if self._blackbox is not None:
+            # One wrapper covers every instrumentation combination when
+            # the black box rides along (the blackbox-off wrappers below
+            # keep their pinned graphs).
+            out = self._step_blackbox(
+                self.state, crashed, append_n, self._counters,
+                self._health, self._blackbox, link,
+            )
+            self.state = out[0]
+            i = 1
+            if cc:
+                self._counters = out[i]
+                i += 1
+            if ch:
+                self._health = out[i]
+                i += 1
+            self._blackbox = out[i]
+            if not (cc or ch or self.health_monitor is not None):
+                return self.state
+        elif cc and ch:
             self.state, self._counters, self._health = self._step_both(
                 self.state, crashed, append_n, self._counters, self._health,
                 link,
@@ -3843,7 +4021,8 @@ class ClusterSim:
         cfg = self.cfg
         cc = self._counters is not None
         ch = self._health is not None
-        n_extra = (1 if cc else 0) + (1 if ch else 0)
+        bb = self._blackbox is not None
+        n_extra = (1 if cc else 0) + (1 if ch else 0) + (1 if bb else 0)
 
         def run(st, crashed, append_n, *extra):
             link = extra[n_extra] if has_link else None
@@ -3864,9 +4043,12 @@ class ClusterSim:
                     j += 1
                 if ch:
                     kw["health"] = ex[j]
+                    j += 1
+                if bb:
+                    kw["blackbox"] = ex[j]
                 res = step(cfg, s, crashed, append_n, link=link, **kw)
                 # SimState is itself a tuple subtype: wrap by flag.
-                if not (cc or ch):
+                if not (cc or ch or bb):
                     res = (res,)
                 s2, raw2 = pack_ra_carry(res[0])
                 return (s2, raw2) + tuple(res[1:]), ()
@@ -3922,9 +4104,10 @@ class ClusterSim:
         )
         cc = self._counters is not None
         ch = self._health is not None
+        bb = self._blackbox is not None
         if cc:
             seg_max = self._drain_cap
-        elif ch and self.health_monitor is not None:
+        elif (ch or bb) and self.health_monitor is not None:
             seg_max = self._drain_every
         else:
             seg_max = rounds
@@ -3947,6 +4130,8 @@ class ClusterSim:
                 args.append(self._counters)
             if ch:
                 args.append(self._health)
+            if bb:
+                args.append(self._blackbox)
             if link is not None:
                 args.append(link)
             out = runner(*args)
@@ -3967,8 +4152,11 @@ class ClusterSim:
                 i += 1
             if ch:
                 self._health = out[i]
+                i += 1
+            if bb:
+                self._blackbox = out[i]
             done += seg
-            if cc or ch:
+            if cc or ch or (bb and self.health_monitor is not None):
                 self._rounds_since_drain += seg
                 if self._rounds_since_drain >= self._drain_every:
                     pending = self._begin_drain()
@@ -4076,9 +4264,14 @@ class ClusterSim:
 
         compiled, runner = self._chaos_runner_for(plan)
         health = self._require_health()
-        self.state, self._health, stats, safety = runner(
-            self.state, health
-        )
+        if self._blackbox is not None:
+            (
+                self.state, self._health, self._blackbox, stats, safety,
+            ) = runner(self.state, health, self._blackbox)
+        else:
+            self.state, self._health, stats, safety = runner(
+                self.state, health
+            )
         # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
         # download of two fixed-size stat vectors, outside the jitted scan.
         stats_h, safety_h = jax.device_get((stats, safety))
@@ -4130,6 +4323,14 @@ class ClusterSim:
         from .health import HealthMonitor
 
         health = self._require_health()
+        fused_zero = False
+        if split and self.cfg.blackbox:
+            # Conservative v1 (ISSUE 15): steady_mask rejects blackbox-on
+            # fused horizons (the fused kernel cannot fold the ring), so
+            # the split runner would defuse every block anyway — run the
+            # general scan and report the fused fraction honestly as 0.
+            split = False
+            fused_zero = True
         if isinstance(plan, reconfig_mod.ReconfigPlan):
             # Pre-flight: plans apply ABSOLUTE Changer-computed target
             # masks walked from the plan's bootstrap config, so the sim
@@ -4242,10 +4443,20 @@ class ClusterSim:
                 self._counters = out[7]
                 self._drain_counters()
         else:
+            out = runner(
+                self.state, health, rst,
+                *(
+                    (self._blackbox,)
+                    if self._blackbox is not None
+                    else ()
+                ),
+            )
             (
                 self.state, self._health, self._reconfig_state,
                 stats, rstats, safety,
-            ) = runner(self.state, health, rst)
+            ) = out[:6]
+            if self._blackbox is not None:
+                self._blackbox = out[6]
         # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
         # download of fixed-size stat vectors + two small planes,
         # outside the jitted scan.
@@ -4271,6 +4482,10 @@ class ClusterSim:
             report["fused_frac"] = round(
                 report["fused_rounds"] / total, 4
             )
+        elif fused_zero:
+            report["fused_rounds"] = 0
+            report["total_rounds"] = compiled.n_rounds * self.cfg.n_groups
+            report["fused_frac"] = 0.0
         if self.health_monitor is not None:
             self.health_monitor.record_reconfig(report)
         return report
@@ -4311,6 +4526,13 @@ class ClusterSim:
         from . import workload as workload_mod
 
         health = self._require_health()
+        fused_zero = False
+        if split and self.cfg.blackbox:
+            # Conservative v1 (ISSUE 15): blackbox-on horizons never fuse
+            # (steady_mask rejects them), so run the general scan and
+            # report fused_frac 0 instead of spinning the split machinery.
+            split = False
+            fused_zero = True
         cached = getattr(self, "_read_runner", None)
         mode = ("split", split_k) if split else "scan"
         if (
@@ -4370,12 +4592,19 @@ class ClusterSim:
             lambda x: self._put(x, True),
             workload_mod.init_read_carry(self.cfg.n_groups),
         )
-        out = runner(self.state, health, rst, rcar)
+        args = [self.state, health, rst, rcar]
+        if self._blackbox is not None:
+            args.append(self._blackbox)
+        out = runner(*args)
         (
             self.state, self._health, _rst, stats, rstats, safety,
             self._read_carry, rdstats, lat_hist,
         ) = out[:9]
-        fused = out[9] if split else None
+        i = 9
+        if self._blackbox is not None:
+            self._blackbox = out[i]
+            i += 1
+        fused = out[i] if split else None
         lat_p = workload_mod.latency_percentiles(lat_hist)
         # graftcheck: allow-no-host-sync-in-jit — deliberate end-of-run
         # download of fixed-size stat vectors, outside the jitted scan.
@@ -4392,6 +4621,10 @@ class ClusterSim:
             report["fused_rounds"] = int(jax.device_get(fused))
             report["total_rounds"] = total
             report["fused_frac"] = round(report["fused_rounds"] / total, 4)
+        elif fused_zero:
+            report["fused_rounds"] = 0
+            report["total_rounds"] = compiled.n_rounds * self.cfg.n_groups
+            report["fused_frac"] = 0.0
         if self.health_monitor is not None:
             self.health_monitor.record_reads(report)
         return report
@@ -4503,6 +4736,81 @@ class ClusterSim:
     def reset_health(self) -> None:
         if self._health is not None:
             self._health = init_health(self.cfg)
+
+    # --- black-box forensics (requires SimConfig(blackbox=True)) ---
+
+    def _require_blackbox(self) -> BlackboxState:
+        if self._blackbox is None:
+            raise RuntimeError(
+                "black box disabled; construct with "
+                "SimConfig(blackbox=True)"
+            )
+        return self._blackbox
+
+    def record_safety(self, viol: jnp.ndarray) -> None:
+        """Stamp a bool[kernels.N_SAFETY, G] violation mask onto the LAST
+        stepped round's black-box record (kernels.blackbox_mark) — the
+        ad-hoc stepping path: drive run_round, audit the transition
+        host-side (kernels.check_safety_groups), hand the mask back here.
+        The compiled runners fold trace and bits in one on-device call
+        instead; nothing here runs in a hot loop."""
+        bb = self._require_blackbox()
+        meta, trip = self._bb_mark(
+            bb.meta, bb.trip_round, bb.round_idx, viol
+        )
+        self._blackbox = bb._replace(meta=meta, trip_round=trip)
+
+    def forensics(self) -> dict:
+        """The fixed-size forensics capture as a plain dict: per safety
+        slot, how many groups have EVER tripped it and the first-K
+        offenders as [{"group": id, "round": first-trip round}, ...]
+        (kernels.blackbox_capture; K = SimConfig.blackbox_topk).  The
+        reduction runs on device and only O(K) bytes download — never the
+        [N_SAFETY, G] trip plane."""
+        bb = self._require_blackbox()
+        # graftcheck: allow-no-host-sync-in-jit — deliberate on-demand
+        # download of the FIXED-SIZE capture, outside the jitted scans.
+        counts, ids, rounds = jax.device_get(
+            self._bb_capture(bb.trip_round)
+        )
+        # graftcheck: allow-no-host-sync-in-jit — one int32 scalar (the
+        # absolute round counter), same on-demand path.
+        folded = int(jax.device_get(bb.round_idx))
+        return {
+            "rounds_folded": folded,
+            "counts": {
+                name: int(c)
+                for name, c in zip(kernels.SAFETY_NAMES, counts)
+            },
+            "offenders": {
+                kernels.SAFETY_NAMES[s]: [
+                    {"group": int(g), "round": int(r)}
+                    for g, r in zip(ids[s], rounds[s])
+                    if g >= 0
+                ]
+                for s in range(kernels.N_SAFETY)
+            },
+        }
+
+    def incident_report(self) -> dict:
+        """The full incident JSON (forensics.build_incident): the capture
+        above plus each offender group's decoded black-box window — the
+        last W rounds of (role, leader, term, commit, fired slots) — the
+        artifact the report tools attach on a nonzero safety count."""
+        from . import forensics as forensics_mod
+
+        return forensics_mod.build_incident(self)
+
+    def reset_forensics(self) -> None:
+        if self._blackbox is not None:
+            self._blackbox = init_blackbox(self.cfg)
+            if self.mesh is not None:
+                from . import sharding as sharding_mod
+
+                self._blackbox = sharding_mod.shard_blackbox(
+                    self._blackbox, self.mesh, self.mesh_axis
+                )
+            self._bb_seen = [0] * kernels.N_SAFETY
 
     def read_index(self, crashed=None, link=None) -> jnp.ndarray:
         """Batched linearizable ReadIndex barrier (see sim.read_index);

@@ -41,7 +41,8 @@ class ScalarCluster:
     def __init__(self, n_groups: int, n_peers: int, election_tick: int = 10,
                  heartbeat_tick: int = 1, voters=None, voters_outgoing=None,
                  learners=None, check_quorum: bool = False,
-                 pre_vote: bool = False, metrics=None):
+                 pre_vote: bool = False, metrics=None,
+                 timeout_seed_base: int = 0):
         """`voters`/`voters_outgoing`/`learners` (peer-id lists) bootstrap
         every group in that (possibly joint) configuration; default: all
         peers voters.  `check_quorum`/`pre_vote` configure every Raft the
@@ -51,7 +52,11 @@ class ScalarCluster:
         SAME flags on both sides (tests/test_damping_parity.py) while the
         undamped suites keep both False.  `metrics` (an optional
         raft_tpu.metrics.Metrics) is shared by every Raft in the cluster —
-        the scalar side of the device counter-plane parity test."""
+        the scalar side of the device counter-plane parity test.
+        `timeout_seed_base` offsets every group's timeout_seed (group g
+        draws from stream timeout_seed_base + g): the forensics one-group
+        repro (raft_tpu/multiraft/forensics.py) replays GLOBAL group id g
+        as a 1-group cluster on stream g, bit-identical to the fleet."""
         self.n_groups = n_groups
         self.n_peers = n_peers
         self.networks: List[Network] = []
@@ -61,7 +66,7 @@ class ScalarCluster:
                 heartbeat_tick=heartbeat_tick,
                 max_size_per_msg=NO_LIMIT,
                 max_inflight_msgs=1 << 20,  # effectively unbounded window
-                timeout_seed=g,
+                timeout_seed=timeout_seed_base + g,
                 check_quorum=check_quorum,
                 pre_vote=pre_vote,
                 metrics=metrics,

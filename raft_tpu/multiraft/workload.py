@@ -403,7 +403,13 @@ def make_runner(
         )
     n_rounds = client.n_rounds
 
-    def run(st, hl, rst, rcar, *sched_args):
+    with_bb = cfg.blackbox
+
+    def run(st, hl, rst, rcar, *args):
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            bb, sched_args = args[0], args[1:]
+        else:
+            sched_args = args
         csched = client._replace(
             phase_of_round=sched_args[0],
             read_fire_packed=sched_args[1],
@@ -421,17 +427,44 @@ def make_runner(
         body = reconfig_mod._runner_body(
             cfg, sched, chaos_sched, client=csched
         )
+        carry = (
+            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
+        )
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            carry = carry + (bb,)
         carry, _ = jax.lax.scan(
             body,
-            (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist),
+            carry,
             jnp.arange(n_rounds, dtype=jnp.int32),
         )
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            carry, bb = carry[:-1], carry[-1]
         stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats, lat_hist = (
             carry
         )
         # The same tail audit as reconfig.make_runner: a final-round
         # apply's mask transition is checked one round later, so fold
         # once more on the final state (commit checks inert).
+        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+            viol = kernels.check_safety_groups(
+                stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
+                stf.commit,
+                voter_mask=stf.voter_mask,
+                outgoing_mask=stf.outgoing_mask,
+                matched=stf.matched,
+                prev_voter_mask=rstf.prev_voter,
+                prev_outgoing_mask=rstf.prev_outgoing,
+            )
+            # dtype= keeps the slot sums int32 under x64 (GC007).
+            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
+            meta, trip = kernels.blackbox_mark(
+                bb.meta, bb.trip_round, bb.round_idx, viol
+            )
+            bb = bb._replace(meta=meta, trip_round=trip)
+            return (
+                stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
+                lat_hist, bb,
+            )
         safety = safety + kernels.check_safety(
             stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
             stf.commit,
@@ -446,7 +479,9 @@ def make_runner(
             lat_hist,
         )
 
-    jitted = jax.jit(run, donate_argnums=(0, 1, 2, 3))
+    jitted = jax.jit(
+        run, donate_argnums=(0, 1, 2, 3, 4) if with_bb else (0, 1, 2, 3)
+    )
     schedule_args = (
         client.phase_of_round, client.read_fire_packed, client.read_mode,
         client.append,
@@ -465,8 +500,8 @@ def make_runner(
         else ()
     )
 
-    def runner(st, hl, rst, rcar):
-        return jitted(st, hl, rst, rcar, *schedule_args)
+    def runner(st, hl, rst, rcar, *bb):
+        return jitted(st, hl, rst, rcar, *bb, *schedule_args)
 
     runner.jitted = jitted  # type: ignore[attr-defined]
     runner.schedule_args = schedule_args  # type: ignore[attr-defined]
@@ -520,6 +555,13 @@ def make_split_runner(
             "make_split_runner runs bare client plans; compose chaos/"
             "reconfig schedules through make_runner (or the reconfig "
             "split machinery) instead"
+        )
+    if cfg.blackbox:
+        raise ValueError(
+            "make_split_runner does not thread the black box (v1: "
+            "steady_mask rejects blackbox-on horizons, so nothing would "
+            "fuse) — use make_runner; ClusterSim.run_reads(split=True) "
+            "falls back automatically"
         )
     if not cfg.collect_health:
         raise ValueError(

@@ -40,10 +40,13 @@ def test_monitor_ring_and_seq():
     mon = HealthMonitor(recorder_size=3)
     for i in range(5):
         mon.record(summary(leaderless=i))
-    ring = mon.flight_recorder()
+    ring = mon.summary_ring()
     assert len(mon) == 3
     assert [e["seq"] for e in ring] == [2, 3, 4]  # oldest evicted
     assert mon.last()["summary"]["counts"]["leaderless"] == 4
+    # The historical name survives as a deprecated alias (ISSUE 15
+    # moved the flight-recorder role to the device black box).
+    assert mon.flight_recorder() == ring
 
 
 def test_monitor_metrics_and_traces():

@@ -46,7 +46,8 @@ REPORT_KEYS = (
 )
 
 
-def run_config(doc: dict, groups: int, cadence: int, on: bool) -> dict:
+def run_config(doc: dict, groups: int, cadence: int, on: bool,
+               blackbox: bool = False) -> "dict | tuple":
     from raft_tpu.multiraft import ClusterSim, SimConfig, chaos
     from raft_tpu.multiraft.autopilot import Autopilot, AutopilotConfig
 
@@ -59,6 +60,7 @@ def run_config(doc: dict, groups: int, cadence: int, on: bool) -> dict:
         # A tight stall threshold so the commit-stall metric resolves
         # mid-scenario episodes, not only the pathological tails.
         commit_stall_ticks=8,
+        blackbox=blackbox,
     )
     sim = ClusterSim(cfg)
     ap = Autopilot(
@@ -77,7 +79,23 @@ def run_config(doc: dict, groups: int, cadence: int, on: bool) -> dict:
     out["safety"] = report["safety"]
     if on:
         out["actions"] = report["actions"]
+    if blackbox:
+        return out, sim, plan
     return out
+
+
+def capture_incident(doc: dict, groups: int, cadence: int, on: bool,
+                     art_dir: str, name: str) -> dict:
+    """ISSUE 15 on-failure hook: re-run the failing configuration with
+    the device black box on (pure observer) and write the incident JSON
+    + generated repro as CI artifacts; the repro replays the chaos fault
+    column (autopilot actions live in the incident windows)."""
+    from raft_tpu.multiraft import forensics
+
+    _, sim, plan = run_config(doc, groups, cadence, on, blackbox=True)
+    return forensics.capture_artifacts(
+        sim, plan, art_dir, stem=f"incident-{name}"
+    )
 
 
 def main() -> int:
@@ -85,6 +103,12 @@ def main() -> int:
     ap.add_argument("--groups", type=int, default=64)
     ap.add_argument("--cadence", type=int, default=6)
     ap.add_argument("--out", default="autopilot-report.json")
+    ap.add_argument(
+        "--artifacts-dir",
+        default="",
+        help="directory for on-failure forensics artifacts (incident "
+        "JSON + generated repro); default: the --out directory",
+    )
     ap.add_argument(
         "--plans",
         default=os.path.join(
@@ -97,6 +121,7 @@ def main() -> int:
         docs = json.load(f)
     out = {"groups": args.groups, "cadence": args.cadence, "plans": {}}
     failed = []
+    to_capture = {}
     agg = {
         side: {k: 0 for k in REPORT_KEYS if k != "mttr_rounds"}
         | {"healed_rounds": 0.0}
@@ -111,6 +136,7 @@ def main() -> int:
         for side, rep in (("off", off), ("on", on)):
             if any(rep["safety"].values()):
                 failed.append(f"{name}/{side}: safety {rep['safety']}")
+                to_capture[name] = (doc, side == "on")
             a = agg[side]
             for k in a:
                 if k == "healed_rounds":
@@ -176,6 +202,18 @@ def main() -> int:
             "aggregate commit-stall group-rounds worsened with the "
             f"autopilot on ({agg['on']['commit_stall_group_rounds']} vs "
             f"{agg['off']['commit_stall_group_rounds']})"
+        )
+    if to_capture:
+        from raft_tpu.multiraft import forensics
+
+        art_dir = args.artifacts_dir or os.path.dirname(
+            os.path.abspath(args.out)
+        )
+        forensics.report_failures(
+            to_capture, out,
+            lambda name, doc, on_side: capture_incident(
+                doc, args.groups, args.cadence, on_side, art_dir, name
+            ),
         )
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=1)

@@ -29,8 +29,16 @@ general chaos rounds both covered) and the run exits non-zero if any
 churn stat diverges from the scan-damped run, pinning that fusion
 cannot change churn results.
 
+On a nonzero safety count the step no longer fails with bare counts
+(ISSUE 15): the offending scenario re-runs with the device black box on
+(`SimConfig(blackbox=True)` — a pure observer, bit-identical protocol
+evolution), and the incident JSON (per-slot offender groups + their
+decoded ring windows) plus the generated one-group datadriven repro are
+written next to the report as CI artifacts
+(forensics.capture_chaos_incident).
+
 Usage:  python tools/chaos_churn_report.py [--groups N] [--fused]
-        [--out FILE]
+        [--out FILE] [--artifacts-dir DIR]
 """
 
 from __future__ import annotations
@@ -173,6 +181,12 @@ def main() -> int:
     )
     ap.add_argument("--out", default="chaos-churn-report.json")
     ap.add_argument(
+        "--artifacts-dir",
+        default="",
+        help="directory for on-failure forensics artifacts (incident "
+        "JSON + generated repro scenario); default: the --out directory",
+    )
+    ap.add_argument(
         "--plans",
         default=os.path.join(
             os.path.dirname(__file__), "..", "tests", "testdata", "chaos",
@@ -184,6 +198,7 @@ def main() -> int:
         docs = json.load(f)
     out = {"groups": args.groups, "plans": {}}
     failed = []
+    to_capture: dict = {}
     total_fused = 0
     for doc in docs:
         name = doc["name"]
@@ -215,6 +230,7 @@ def main() -> int:
         for tag, rep in checked:
             if any(rep["safety"].values()):
                 failed.append(f"{name}/{tag}: safety {rep['safety']}")
+                to_capture[name] = (doc, tag != "undamped")
         print(
             f"{name}: max_term {undamped['max_term']} -> "
             f"{damped['max_term']}, peak bumps "
@@ -239,6 +255,22 @@ def main() -> int:
             "asymmetric-link: damped term growth "
             f"{asym['damped']['max_term']} did not undercut undamped "
             f"{asym['undamped']['max_term']}"
+        )
+    if to_capture:
+        # Nonzero safety: attach the drill-down artifacts (ISSUE 15) —
+        # the incident JSON and the generated one-group repro — instead
+        # of failing with bare counts.
+        from raft_tpu.multiraft import forensics
+
+        art_dir = args.artifacts_dir or (
+            os.path.dirname(os.path.abspath(args.out))
+        )
+        forensics.report_failures(
+            to_capture, out,
+            lambda name, doc, damped: forensics.capture_chaos_incident(
+                doc, args.groups, art_dir, damped=damped,
+                stem=f"incident-{name}",
+            ),
         )
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=1)

@@ -1,0 +1,115 @@
+"""Deliberately-trapped forensics smoke (the ISSUE 15 CI artifact gate).
+
+The golden corpora stay safety-zero, so the report tools' on-failure
+artifact path would never execute in a healthy build — this job proves
+it actually fires.  It injects both committed traps with the black box
+on (the PR 13 clock-pause stale-read trap and the PR 5
+stale-commit-propagation class), drives the FULL trap-to-testcase
+pipeline with zero manual steps, and exits non-zero unless, for each
+trap:
+
+  * the device capture names EXACTLY the injected offender groups;
+  * the incident JSON and the generated datadriven repro scenario were
+    written (the artifacts CI uploads);
+  * the repro replays RED on the one-group scalar oracle (the violation
+    reproduces on real scalar Rafts);
+  * the same scenario replays GREEN with its trap directives disabled.
+
+Usage:  python tools/forensics_smoke.py [--out-dir DIR] [--groups N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import TYPE_CHECKING
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if TYPE_CHECKING:
+    from raft_tpu.multiraft.forensics import TrapSession
+
+
+def check_trap(name: str, session: "TrapSession", offenders: list,
+               slot: str, out_dir: str, errors: list) -> dict:
+    from raft_tpu.multiraft import forensics
+
+    cap = session.sim.forensics()
+    got = sorted(o["group"] for o in cap["offenders"][slot])
+    if got != sorted(offenders):
+        errors.append(
+            f"{name}: captured groups {got} != injected "
+            f"{sorted(offenders)}"
+        )
+    out = session.extract(out_dir, stem=name)
+    for path_key in ("incident_path", "scenario_path"):
+        if not os.path.exists(out[path_key]):
+            errors.append(f"{name}: missing artifact {out[path_key]}")
+    if not out["reproduced"]:
+        errors.append(
+            f"{name}: generated repro did NOT reproduce {out['slot']} "
+            f"on the scalar oracle ({out['fired']})"
+        )
+    green = forensics.replay_scenario(
+        out["scenario_path"], disable_traps=True
+    )
+    if any(green["fired"].values()):
+        errors.append(
+            f"{name}: repro still fires with traps disabled "
+            f"({green['fired']}) — the scenario is not isolating the "
+            "injected trap"
+        )
+    print(
+        f"{name}: slot={out['slot']} group={out['group']} "
+        f"round={out['round']} reproduced={out['reproduced']} "
+        f"green_without_trap={not any(green['fired'].values())}"
+    )
+    return {
+        "slot": out["slot"],
+        "group": out["group"],
+        "round": out["round"],
+        "reproduced": out["reproduced"],
+        "incident": out["incident_path"],
+        "scenario": out["scenario_path"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default="forensics-smoke")
+    ap.add_argument("--groups", type=int, default=8)
+    args = ap.parse_args()
+    from raft_tpu.multiraft import forensics
+
+    errors: list = []
+    summary = {}
+    offenders = [g for g in range(args.groups) if g % 3 == 1]
+    s1 = forensics.run_commit_regress_trap(
+        n_groups=args.groups, offenders=offenders
+    )
+    summary["commit_regress"] = check_trap(
+        "commit_regress", s1, offenders, "commit_regressed",
+        args.out_dir, errors,
+    )
+    s2 = forensics.run_clock_pause_trap(n_groups=2, offenders=[1])
+    summary["clock_pause"] = check_trap(
+        "clock_pause", s2, [1], "stale_read", args.out_dir, errors,
+    )
+    with open(
+        os.path.join(args.out_dir, "smoke-summary.json"), "w",
+        encoding="utf-8",
+    ) as f:
+        json.dump(summary, f, indent=1)
+    if errors:
+        for msg in errors:
+            print(f"ERROR: {msg}", file=sys.stderr)
+        return 2
+    print("forensics smoke: both traps captured, reproduced, and "
+          "isolated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,15 @@ PACKED_PLANES: Dict[str, tuple] = {
     "roles": (30, "state<4, leader_id<16, hb<=heartbeat_tick<2**24"),
     #   masks word = voter | member << 1 | crashed << 2 (three bools).
     "masks": (3, "three bool planes"),
+    # kernels.pack_blackbox_meta/unpack_blackbox_meta lanes (ISSUE 15):
+    # the black-box ring record word — role < 4 (the ROLE_* code set, 2
+    # bits), acting leader id in [0, n_peers] with n_peers <= 8 (the TPU
+    # peer-axis bound; 4 bits), and the N_SAFETY == 9 per-round
+    # fired-slot indicators (1 bit each) = 15 bits
+    # (docs/STATIC_ANALYSIS.md "Black-box planes").
+    "blackbox_meta": (
+        15, "role<4, leader_id<=n_peers<16, N_SAFETY=9 violation bits"
+    ),
 }
 
 # Damping planes (ISSUE 7): device state added by check-quorum/pre-vote,
@@ -137,6 +146,29 @@ TRANSFER_PLANES: Dict[str, tuple] = {
         "peer id in [0, n_peers]; set from validated commands "
         "(kernels.apply_transfer) or cleared, never arithmetic",
     ),
+}
+
+# Black-box planes (ISSUE 15): the device flight recorder
+# (sim.BlackboxState), registered like the damping planes so a
+# field/dtype change goes through this registry.  The W-window wrap
+# derivation (docs/STATIC_ANALYSIS.md "Black-box planes"): the three
+# [W, G] ring planes are OVERWRITTEN in place every W rounds
+# (slot = round_idx % W — kernels.blackbox_fold never accumulates into
+# them), so they have no growth surface at all; `trip_round` is a
+# min-fold of absolute round indices, every one < the compiled horizon
+# < 2**31 (the chaos/reconfig/workload compile bounds) or the INF
+# sentinel; `round_idx` grows +1/round, wrap horizon 2**31 rounds —
+# out of model, like the commit plane itself.  Enforced by check_sim:
+# BlackboxState's fields and this registry must agree exactly.
+BLACKBOX_PLANES: Dict[str, str] = {
+    "meta": "ring slot, overwritten every W rounds (no accumulation); "
+            "word bits bounded by PACKED_PLANES `blackbox_meta`",
+    "term": "ring slot of group max term (bounded by the protocol's own "
+            "int32 term plane)",
+    "commit": "ring slot of group max commit (bounded by the int32 "
+              "commit plane)",
+    "trip_round": "min-fold of round indices < compiled horizon < 2**31",
+    "round_idx": "+1/round; wrap horizon 2**31 rounds, out of model",
 }
 
 # Read planes (ISSUE 13): the client-workload runner's int32 accumulators
@@ -473,11 +505,42 @@ def check_workload(sf: SourceFile) -> Iterator[Violation]:
 def check_sim(sf: SourceFile) -> Iterator[Violation]:
     cluster: Optional[ast.ClassDef] = None
     sim_state: Optional[ast.ClassDef] = None
+    bb_state: Optional[ast.ClassDef] = None
     for node in ast.iter_child_nodes(sf.ast_tree):
         if isinstance(node, ast.ClassDef) and node.name == "ClusterSim":
             cluster = node
         if isinstance(node, ast.ClassDef) and node.name == "SimState":
             sim_state = node
+        if isinstance(node, ast.ClassDef) and node.name == "BlackboxState":
+            bb_state = node
+    if bb_state is not None:
+        # BLACKBOX_PLANES enforcement (ISSUE 15): the recorder's fields
+        # and the registry must agree EXACTLY — an unregistered field is
+        # an accumulator shipping without a wrap derivation, an orphaned
+        # registry key is rot.
+        bb_fields = {
+            item.target.id
+            for item in bb_state.body
+            if isinstance(item, ast.AnnAssign)
+            and isinstance(item.target, ast.Name)
+        }
+        for name in sorted(set(BLACKBOX_PLANES) - bb_fields):
+            yield _v(
+                sf,
+                bb_state.lineno,
+                f"BLACKBOX_PLANES registers {name!r} but BlackboxState "
+                "has no such field; the registered bound is orphaned — "
+                "rename the registry entry with the field",
+            )
+        for name in sorted(bb_fields - set(BLACKBOX_PLANES)):
+            yield _v(
+                sf,
+                bb_state.lineno,
+                f"BlackboxState field {name!r} is not in the GC008 "
+                "BLACKBOX_PLANES registry "
+                "(tools/graftcheck/engine/overflow.py); derive its wrap "
+                "bound and register it (docs/STATIC_ANALYSIS.md)",
+            )
     if sim_state is not None:
         # DAMPING_PLANES enforcement: the registered damping planes must
         # exist as SimState fields (a rename silently orphaning a

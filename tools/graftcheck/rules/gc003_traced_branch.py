@@ -52,6 +52,9 @@ _STATIC_CONFIG_FIELDS = {
     "pre_vote",
     "transfer",
     "lease_read",
+    "blackbox",
+    "blackbox_window",
+    "blackbox_topk",
     "spmd",
     "min_timeout",
     "max_timeout",

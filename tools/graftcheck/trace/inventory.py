@@ -252,12 +252,15 @@ def _dispatcher_builder(damping: dict, with_health: bool):
     return build
 
 
-def _chaos_runner_builder():
+def _chaos_runner_builder(blackbox: bool = False):
     def build() -> Built:
         from raft_tpu.multiraft import chaos
 
         sim = _sim()
-        cfg = sim.SimConfig(n_groups=G, n_peers=P, collect_health=True)
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True,
+            blackbox=blackbox,
+        )
         st, _, _ = _base_args(cfg)
         plan = chaos.ChaosPlan(
             name="graftcheck-inventory",
@@ -273,10 +276,32 @@ def _chaos_runner_builder():
         runner = chaos.make_runner(cfg, compiled)
         # make_runner exposes its underlying jit and full argument list
         # (state, health, *schedule arrays) precisely for this audit.
+        bb = (sim.init_blackbox(cfg),) if blackbox else ()
         return Built(
             runner.jitted,
-            (st, sim.init_health(cfg)) + runner.schedule_args,
-            (0, 1),
+            (st, sim.init_health(cfg)) + bb + runner.schedule_args,
+            (0, 1, 2) if blackbox else (0, 1),
+        )
+
+    return build
+
+
+def _blackbox_step_builder():
+    def build() -> Built:
+        sim = _sim()
+        cfg = sim.SimConfig(
+            n_groups=G, n_peers=P, collect_health=True, blackbox=True
+        )
+        cs = sim.ClusterSim(cfg)
+        st, crashed, append_n = _base_args(cfg)
+        # The wrapper declares donate_argnums=(0, 3, 4, 5); argnum 3
+        # (the counter plane) is None in this health+blackbox combo, so
+        # the lowering donates (0, 4, 5) — declare what lowers.
+        return Built(
+            cs._step_blackbox,
+            (st, crashed, append_n, None, cs._health, cs._blackbox,
+             None),
+            (0, 4, 5),
         )
 
     return build
@@ -854,6 +879,29 @@ def _specs() -> List[GraphSpec]:
             name="chaos_runner@health",
             anchor="raft_tpu/multiraft/chaos.py",
             build=_chaos_runner_builder(),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The forensics-instrumented chaos scan (ISSUE 15): the
+            # black-box carry donated through the scan, the per-group
+            # safety fold (check_safety_groups) replacing the aggregate
+            # one, ring + trip folds per round.  The blackbox-OFF graph
+            # is the pinned chaos_runner@health row above.
+            name="chaos_runner@blackbox",
+            anchor="raft_tpu/multiraft/chaos.py",
+            build=_chaos_runner_builder(blackbox=True),
+        )
+    )
+    out.append(
+        GraphSpec(
+            # The forensics-instrumented round (ISSUE 15): health + the
+            # black-box trace fold riding step(blackbox=) — the
+            # blackbox-OFF graphs are the bit-identical step@* rows
+            # above (the pinned-unchanged claim).
+            name="step@health+blackbox",
+            anchor=sim_py,
+            build=_blackbox_step_builder(),
         )
     )
     reconfig_py = "raft_tpu/multiraft/reconfig.py"

@@ -52,7 +52,8 @@ _KEEP = (
 )
 
 
-def run_scenario(doc: dict, groups: int, damped: bool) -> dict:
+def run_scenario(doc: dict, groups: int, damped: bool,
+                 blackbox: bool = False) -> "dict | tuple":
     from raft_tpu.multiraft import ClusterSim, SimConfig, chaos, reconfig
 
     plan = reconfig.plan_from_dict(doc["reconfig"])
@@ -63,16 +64,42 @@ def run_scenario(doc: dict, groups: int, damped: bool) -> dict:
         collect_health=True,
         check_quorum=damped,
         pre_vote=damped,
+        blackbox=blackbox,
     )
     sim = ClusterSim(cfg, *reconfig.initial_masks(plan, groups))
     report = sim.run_reconfig(plan, cplan)
-    return {k: report[k] for k in _KEEP}
+    kept = {k: report[k] for k in _KEEP}
+    if blackbox:
+        return kept, sim, cplan
+    return kept
+
+
+def capture_incident(doc: dict, groups: int, damped: bool,
+                     art_dir: str, name: str) -> dict:
+    """ISSUE 15 on-failure hook: re-run the failing scenario with the
+    device black box on (pure observer — bit-identical evolution) and
+    write the incident JSON + generated repro as CI artifacts.  The
+    repro replays the chaos fault column; the composed reconfig ops are
+    in the incident JSON's windows, not the scenario (a NOT-REPRODUCED
+    outcome points the debugging at the reconfig machinery)."""
+    from raft_tpu.multiraft import forensics
+
+    _, sim, cplan = run_scenario(doc, groups, damped, blackbox=True)
+    return forensics.capture_artifacts(
+        sim, cplan, art_dir, stem=f"incident-{name}"
+    )
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--groups", type=int, default=128)
     ap.add_argument("--out", default="", metavar="FILE")
+    ap.add_argument(
+        "--artifacts-dir",
+        default="",
+        help="directory for on-failure forensics artifacts (incident "
+        "JSON + generated repro); default: the --out directory (or cwd)",
+    )
     args = ap.parse_args()
 
     with open(CORPUS, "r", encoding="utf-8") as f:
@@ -80,6 +107,7 @@ def main() -> int:
 
     out = {"groups": args.groups, "plans": {}}
     failures = []
+    to_capture = {}
     for doc in corpus:
         name = doc["name"]
         entry = {}
@@ -90,6 +118,7 @@ def main() -> int:
                 failures.append(
                     f"{name} [{label}]: safety violations {rep['safety']}"
                 )
+                to_capture[name] = (doc, damped)
         if (
             name == "joint_exit_blocked"
             and entry["undamped"]["reconfig_stalled_groups"] == 0
@@ -108,6 +137,20 @@ def main() -> int:
                   for label, rep in entry.items()
               ),
               file=sys.stderr)
+
+    if to_capture:
+        from raft_tpu.multiraft import forensics
+
+        art_dir = args.artifacts_dir or (
+            os.path.dirname(os.path.abspath(args.out)) if args.out
+            else "."
+        )
+        forensics.report_failures(
+            to_capture, out,
+            lambda name, doc, damped: capture_incident(
+                doc, args.groups, damped, art_dir, name
+            ),
+        )
 
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
