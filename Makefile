@@ -23,7 +23,8 @@ test:
 
 # Static analysis (docs/STATIC_ANALYSIS.md): graftcheck always runs (the
 # AST/engine layers are zero-dependency; --engine adds the cross-module
-# abstract-interpretation rules GC007-GC010, and the mtime run cache keeps
+# abstract-interpretation rules GC007-GC010 plus the GC016 registry-closure
+# and GC017 stale-marker audits, and the mtime run cache keeps
 # an unchanged tree under ~2s).  The trace layer (--trace, GC011-GC014)
 # proves properties of the LOWERED graphs and therefore needs jax: it runs
 # whenever jax imports (an unchanged inventory replays from the cache in

@@ -233,7 +233,7 @@ def make_cadence_runner(
         if not fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
             return general(inner0 + (csr,)) + (jnp.int32(0),)
 
-        if chaos_compiled is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if chaos_compiled is not None:
             link, loss, crashed, capp = chaos_mod.schedule_planes(
                 chaos_sched, r0
             )
@@ -255,7 +255,7 @@ def make_cadence_runner(
         same_phase = (
             sched.phase_of_round[r0] == sched.phase_of_round[last]
         )
-        if chaos_compiled is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if chaos_compiled is not None:
             same_phase = same_phase & (
                 chaos_sched.phase_of_round[r0]
                 == chaos_sched.phase_of_round[last]
@@ -292,7 +292,7 @@ def make_cadence_runner(
                 bb = None
             prev_ll = hl.planes[kernels.HP_LEADERLESS]
             fargs = (st, crashed, append)
-            if chaos_compiled is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            if chaos_compiled is not None:
                 fargs = fargs + (loss, r0)
             st2, hl2 = fused_fn(*fargs, hl)
             stats2 = chaos_mod.update_chaos_stats(

@@ -449,7 +449,7 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
     with_bb = cfg.blackbox
 
     def body(carry, r, sched):
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             st, hl, bb, stats, safety = carry
         else:
             st, hl, stats, safety = carry
@@ -459,7 +459,7 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
         st2, hl2 = sim_mod.step(
             cfg, st, crashed, append, health=hl, link=link
         )
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             viol = kernels.check_safety_groups(
                 st2.state, st2.term, st2.commit, st2.last_index,
                 st2.agree, st.commit,
@@ -488,7 +488,7 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
         return out, ()
 
     def run(st, hl, *args):
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             bb, args = args[0], args[1:]
         (phase_of_round, link_packed, loss_packed, crashed_packed,
          append) = args

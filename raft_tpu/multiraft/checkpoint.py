@@ -21,18 +21,21 @@ from typing import Dict
 import numpy as np
 import jax.numpy as jnp
 
+from . import planes
 from .sim import SimState
 
 _FORMAT_VERSION = 1
 
 
 def save_state(state: SimState, path: str) -> None:
-    """Atomically write the full device state to `path` (.npz).  Optional
-    planes that are absent (recent_active on an undamped sim is None) are
-    skipped; load_state restores them as None."""
+    """Atomically write the full device state to `path` (.npz).  The field
+    set is the plane registry's "state" checkpoint family (planes.py; ==
+    SimState._fields, pinned by GC016).  Optional planes that are absent
+    (recent_active on an undamped sim is None) are skipped; load_state
+    restores them as None."""
     arrays = {
         name: np.asarray(value)
-        for name in SimState._fields
+        for name in planes.checkpoint_fields("state")
         if (value := getattr(state, name)) is not None
     }
     arrays["__version__"] = np.asarray(_FORMAT_VERSION)
@@ -58,12 +61,10 @@ def load_state(path: str) -> SimState:
         if version != _FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint version {version}")
         fields = {}
-        # Only None-default fields are optional planes; a future field
-        # with a real default must still be present in every checkpoint.
-        optional = {
-            k for k, v in SimState._field_defaults.items() if v is None
-        }
-        for name in SimState._fields:
+        # Only flag-gated registry rows are optional planes; a future
+        # field without a gating flag must be present in every checkpoint.
+        optional = set(planes.optional_sim_fields())
+        for name in planes.checkpoint_fields("state"):
             if name not in data:
                 if name in optional:
                     continue  # optional plane absent (undamped checkpoint)
@@ -88,11 +89,9 @@ def save_reconfig_state(rstate, path: str) -> None:
     planes) alongside a SimState checkpoint, so a membership-churn run
     resumes mid-plan bit-identically (the schedule arrays themselves are
     recompiled from the plan — only the mutable carry needs persisting)."""
-    from .reconfig import ReconfigState
-
     arrays = {
         name: np.asarray(getattr(rstate, name))
-        for name in ReconfigState._fields
+        for name in planes.checkpoint_fields("reconfig")
     }
     arrays["__reconfig_version__"] = np.asarray(_RECONFIG_FORMAT_VERSION)
     dir_ = os.path.dirname(os.path.abspath(path)) or "."
@@ -125,7 +124,7 @@ def load_reconfig_state(path: str):
                 f"unsupported reconfig checkpoint version {version}"
             )
         fields = {}
-        for name in ReconfigState._fields:
+        for name in planes.checkpoint_fields("reconfig"):
             if name not in data:
                 raise ValueError(
                     f"reconfig checkpoint {path!r} is missing plane "
@@ -138,11 +137,11 @@ def load_reconfig_state(path: str):
 
 _READ_FORMAT_VERSION = 1
 
-# The persisted read-protocol planes, in save order: the outstanding-read
-# carry (workload.ReadCarry) plus the run's accumulators, so a resumed
-# client workload reproduces its latency percentiles and serve counts
-# bit-identically.
-_READ_FIELDS = ("pending_mode", "pending_since", "read_stats", "lat_hist")
+# The persisted read-protocol planes, in registry save order: the
+# outstanding-read carry (workload.ReadCarry) plus the run's accumulators,
+# so a resumed client workload reproduces its latency percentiles and
+# serve counts bit-identically.
+_READ_FIELDS = planes.checkpoint_fields("read")
 
 
 def save_read_state(rcar, read_stats, lat_hist, path: str) -> None:
@@ -212,11 +211,12 @@ def load_read_state(path: str):
 
 _BLACKBOX_FORMAT_VERSION = 1
 
-# The persisted black-box planes, in BlackboxState field order: the ring
-# windows, the first-trip plane, and the absolute round counter — so a
-# post-mortem can be extracted from a crashed run's checkpoint exactly as
-# from the live sim (forensics.decode_window reads the same arrays).
-_BLACKBOX_FIELDS = ("meta", "term", "commit", "trip_round", "round_idx")
+# The persisted black-box planes, in BlackboxState field order (the
+# registry pins the order against the NamedTuple): the ring windows, the
+# first-trip plane, and the absolute round counter — so a post-mortem can
+# be extracted from a crashed run's checkpoint exactly as from the live
+# sim (forensics.decode_window reads the same arrays).
+_BLACKBOX_FIELDS = planes.checkpoint_fields("blackbox")
 
 
 def save_blackbox_state(blackbox, path: str) -> None:

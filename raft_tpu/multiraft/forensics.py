@@ -225,8 +225,6 @@ def build_incident(sim) -> dict:
     })
     windows: Dict[str, List[dict]] = {}
     for g in groups:
-        # graftcheck: allow-no-host-sync-in-jit — on-demand post-mortem
-        # download of one group's [W] ring columns, outside any jit.
         meta_c, term_c, commit_c = jax.device_get(
             (bb.meta[:, g], bb.term[:, g], bb.commit[:, g])
         )
@@ -766,8 +764,6 @@ class TrapSession:
         sim.state = st2
         sim._blackbox = bb2
         sim.record_safety(viol)
-        # graftcheck: allow-no-host-sync-in-jit — test/forensics harness
-        # accounting, outside any jit.
         self.safety += np.asarray(
             viol.sum(axis=1), dtype=np.int64
         )

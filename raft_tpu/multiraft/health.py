@@ -394,13 +394,6 @@ class HealthMonitor:
         with self._lock:
             return list(self._summary_ring)
 
-    def flight_recorder(self) -> List[dict]:
-        """DEPRECATED alias for summary_ring(): the historical name now
-        belongs to the DEVICE black box (SimConfig.blackbox /
-        ClusterSim.forensics()); this host-side ring holds summaries and
-        scenario reports, not per-round flight data."""
-        return self.summary_ring()
-
     def __len__(self) -> int:
         with self._lock:
             return len(self._summary_ring)

@@ -61,6 +61,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import kernels as kernels_mod
+from . import planes
 from . import sim as sim_mod
 from .kernels import (
     CTR_COMMIT_ENTRIES,
@@ -1418,13 +1419,16 @@ def steady_mask(
     (workload.make_split_runner; fused-vs-general bit-parity in
     tests/test_workload.py).  None keeps every existing graph
     unchanged."""
-    if cfg.blackbox:
-        # Conservative v1 (ISSUE 15): the fused kernel cannot fold the
-        # black-box ring (the per-round trace write is wave-path logic),
-        # so an instrumented-forensics config rejects every fused horizon
-        # and rides the general path; bench.py --blackbox measures the
-        # cost, and the blackbox=False graphs here are untouched.
-        return jnp.zeros((cfg.n_groups,), bool)
+    for flag in planes.steady_defuse_flags():
+        # Registry-driven wholesale defuse (planes.py steady == "defuse";
+        # today only `blackbox`, ISSUE 15): the fused kernel cannot fold
+        # these rows' per-round wave-path writes (the black-box ring
+        # trace), so configs enabling them reject every fused horizon and
+        # ride the general path; bench.py --blackbox measures the cost,
+        # and graphs with every defuse flag off are untouched (this is a
+        # python-level branch on static config fields).
+        if getattr(cfg, flag):  # graftcheck: allow-no-python-branch-on-traced — `flag` names a static SimConfig bool (registry steady == "defuse"; GC016 pins the field's existence), so this getattr is a trace-time constant
+            return jnp.zeros((cfg.n_groups,), bool)
     damped = cfg.check_quorum or cfg.pre_vote
     if damped and cfg.election_tick <= cfg.heartbeat_tick:
         # The check-quorum saturation argument needs one full heartbeat

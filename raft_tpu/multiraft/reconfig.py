@@ -729,8 +729,6 @@ def split_plan(
     fused block.
     """
     R = compiled.n_rounds
-    # graftcheck: allow-no-host-sync-in-jit — host-side planning over the
-    # small schedule arrays, before any jitted segment runs.
     op_start = np.asarray(compiled.op_start)  # [K, G]
     n_ops = np.asarray(compiled.n_ops)  # [G]
     tgt_out = np.asarray(compiled.tgt_outgoing)  # [K, P, G]
@@ -859,12 +857,12 @@ def _runner_body(
 
     def body(carry, r):
         bb = None
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             carry, bb = carry[:-1], carry[-1]
         rcar = rdstats = lat_hist = None
-        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if client is not None:
             carry, (rcar, rdstats, lat_hist) = carry[:-3], carry[-3:]
-        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if with_counters:
             st, hl, rst, stats, rstats, safety, ctrs = carry
         else:
             st, hl, rst, stats, rstats, safety = carry
@@ -877,7 +875,7 @@ def _runner_body(
         else:
             link = None
             crashed = jnp.zeros((P, G), bool)
-        if actions is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if actions is not None:
             act_round, transfer_plane, kick_plane = actions
             fire = r == act_round
             transfer_propose = jnp.where(fire, transfer_plane, 0)
@@ -885,7 +883,7 @@ def _runner_body(
         else:
             transfer_propose = None
             campaign_kick = None
-        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if client is not None:
             # The round's client traffic: phase append skew plus read
             # fires (packed bits along G); an outstanding read retries
             # every round until served, a fire finding one outstanding is
@@ -930,9 +928,9 @@ def _runner_body(
             read_propose=read_propose,
         )
         receipt = None
-        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if client is not None:
             step_out, receipt = step_out[:-1], step_out[-1]
-        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if with_counters:
             st2, ctrs2, hl2, prop = step_out
         else:
             st2, hl2, prop = step_out
@@ -965,7 +963,7 @@ def _runner_body(
         # TRANSITION pair (prev round's step masks -> this round's) audits
         # the previous round's apply.
         viol = None
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             viol = kernels.check_safety_groups(
                 st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
                 st.commit,
@@ -1039,9 +1037,9 @@ def _runner_body(
             prev_outgoing=st2.outgoing_mask,
         )
         out = (st3, hl2, rst2, stats, rstats, safety)
-        if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if with_counters:
             out = out + (ctrs2,)
-        if client is not None:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if client is not None:
             # Serve accounting: a non-negative receipt closes the group's
             # outstanding read with latency (r - issue_round), folded into
             # the device histogram (bucket = min(latency, cap), cap =
@@ -1069,7 +1067,7 @@ def _runner_body(
                 pending_since=jnp.where(served, 0, psince),
             )
             out = out + (rcar, rdstats, lat_hist)
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             # The ring records the round-EXIT (post-apply) state; the
             # fired bits come from the audit above, so one fold covers
             # trace and trigger capture.
@@ -1115,7 +1113,7 @@ def make_runner(
         return _runner_body(cfg, sched, chaos_sched)(carry, r)
 
     def run(st, hl, rst, *args):
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             bb, sched_args = args[0], args[1:]
         else:
             sched_args = args
@@ -1126,21 +1124,21 @@ def make_runner(
         rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
         safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
         carry = (st, hl, rst, stats, rstats, safety)
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             carry = carry + (bb,)
         carry, _ = jax.lax.scan(
             lambda c, r: body(c, r, sched, chaos_sched),
             carry,
             jnp.arange(n_rounds, dtype=jnp.int32),
         )
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             carry, bb = carry[:-1], carry[-1]
         stf, hlf, rstf, stats, rstats, safety = carry
         # Tail audit: the scan body checks each apply's mask transition
         # one round later, so a final-round apply needs this one extra
         # fold (prev_commit = final commit keeps the commit checks inert
         # — only the transition + election-safety slots can fire).
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             viol = kernels.check_safety_groups(
                 stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
                 stf.commit,
@@ -1313,7 +1311,7 @@ def make_split_runner(
             compiled, chaos_compiled, sched_args
         )
         body = _runner_body(cfg, sched, chaos_sched, with_counters)
-        if chaos_on:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+        if chaos_on:
             link, loss, crashed, capp = chaos_mod.schedule_planes(
                 chaos_sched, r0
             )
@@ -1333,12 +1331,12 @@ def make_split_runner(
             st, hl, rst, stats, rstats, safety, *c = args
             prev_ll = hl.planes[kernels.HP_LEADERLESS]
             fargs = (st, crashed, append)
-            if chaos_on:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            if chaos_on:
                 fargs = fargs + (loss, r0)
             if with_counters:
                 fargs = fargs + (c[0],)
             out = fused_fn(*fargs, hl)
-            if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            if with_counters:
                 st2, ctrs2, hl2 = out
             else:
                 st2, hl2 = out
@@ -1356,7 +1354,7 @@ def make_split_runner(
                 prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
             )
             res = (st2, hl2, rst2, stats2, rstats, safety)
-            if with_counters:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
+            if with_counters:
                 res = res + (ctrs2,)
             return res
 

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import sim
+from . import planes, sim
 from .kernels import ROLE_LEADER
 from .sim import SimConfig, SimState
 
@@ -55,28 +55,41 @@ def make_mesh(
     return jax.make_mesh((len(devices),), (axis,), devices=list(devices))
 
 
+def _row_sharding(mesh: Mesh, axis: str, row) -> NamedSharding:
+    """The registry row's NamedSharding: "minor-G" shards the trailing
+    group axis with every leading axis replicated ("[P, G]" -> P(None,
+    axis), "[P, P, G]" -> P(None, None, axis), "[G]" -> P(axis));
+    "replicate" is a whole-array replica (scalars, fixed-size
+    accumulators)."""
+    if row.sharding == "replicate":
+        return NamedSharding(mesh, P())
+    assert row.sharding == "minor-G", row
+    return NamedSharding(
+        mesh, P(*(None,) * planes.leading_axes(row), axis)
+    )
+
+
 def state_sharding(
     mesh: Mesh, axis: str = "groups", damped: bool = False,
     transfer: bool = False,
 ) -> SimState:
-    """PartitionSpecs for every SimState field: the group axis (minor, the
-    vector-lane axis of the peer-major [P, G] layout) is sharded; the peer
-    axis stays local to the chip.  `damped` adds the spec for the
-    recent_active [P, P, G] plane (present only when SimConfig damping is
-    on — it shards on G like the other pairwise planes); `transfer` the
-    spec for the lead_transferee [P, G] plane (SimConfig.transfer), which
-    shards on G like every other per-peer plane."""
-    pg = NamedSharding(mesh, P(None, axis))
-    ppg = NamedSharding(mesh, P(None, None, axis))
-    return SimState(
-        term=pg, state=pg, vote=pg, leader_id=pg,
-        election_elapsed=pg, heartbeat_elapsed=pg, randomized_timeout=pg,
-        last_index=pg, last_term=pg, commit=pg,
-        matched=ppg, term_start_index=pg, agree=ppg, voter_mask=pg,
-        outgoing_mask=pg, learner_mask=pg,
-        recent_active=ppg if damped else None,
-        transferee=pg if transfer else None,
-    )
+    """PartitionSpecs for every SimState field, built from the plane
+    registry (planes.py): the group axis (minor, the vector-lane axis of
+    the peer-major [P, G] layout) is sharded; the peer axis stays local
+    to the chip.  Flag-gated rows get a spec only when their flag maps to
+    an enabled argument — `damped` covers the check_quorum/pre_vote rows
+    (recent_active [P, P, G], sharded on G like the other pairwise
+    planes), `transfer` the lead_transferee [P, G] row — and None
+    otherwise, matching the absent plane."""
+    enabled = {"check_quorum": damped, "pre_vote": damped,
+               "transfer": transfer}
+    specs = {}
+    for row in planes.rows(owner="SimState"):
+        if row.flag and not any(enabled.get(f, False) for f in row.flag):
+            specs[row.name] = None
+        else:
+            specs[row.name] = _row_sharding(mesh, axis, row)
+    return SimState(**specs)
 
 
 def shard_state(state: SimState, mesh: Mesh, axis: str = "groups") -> SimState:
@@ -152,11 +165,10 @@ def blackbox_sharding(mesh: Mesh, axis: str = "groups"):
     same registered-gather shape as the sharded health drain."""
     from .sim import BlackboxState
 
-    xg = NamedSharding(mesh, P(None, axis))
-    return BlackboxState(
-        meta=xg, term=xg, commit=xg, trip_round=xg,
-        round_idx=NamedSharding(mesh, P()),
-    )
+    return BlackboxState(**{
+        row.name: _row_sharding(mesh, axis, row)
+        for row in planes.rows(owner="BlackboxState")
+    })
 
 
 def shard_blackbox(blackbox, mesh: Mesh, axis: str = "groups"):
@@ -312,9 +324,6 @@ def global_status(cfg: SimConfig, mesh: Mesh, axis: str = "groups"):
 
     def status(st: SimState) -> dict:
         out = dict(jitted(st))
-        # graftcheck: allow-no-host-sync-in-jit — the fixed-size [4] limb
-        # download happens HERE, outside the jitted reduction, exactly
-        # like the health-summary drain.
         limb_vals = jax.device_get(out.pop("total_commit_limbs"))
         out["total_commit"] = sum(
             int(v) << (8 * i) for i, v in enumerate(limb_vals)

@@ -110,7 +110,7 @@ from typing import List, NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from . import kernels
+from . import kernels, planes
 from .kernels import ROLE_CANDIDATE, ROLE_FOLLOWER, ROLE_LEADER
 
 
@@ -578,35 +578,43 @@ def init_state(
     )
 
 
+# The plane that rides the scan carry bit-packed, from the registry
+# (planes.py `packing == "bits_g"`; exactly one row today — the
+# destructuring fails loudly if a second packed-carry plane lands without
+# generalizing the carry to a tuple of word planes).
+(_PACKED_CARRY_FIELD,) = planes.packed_carry_fields()
+
+
 def pack_ra_carry(
     st: SimState,
 ) -> Tuple[SimState, Optional[jnp.ndarray]]:
     """Split `st` into (state-without-recent_active, packed words) for a
     scan carry: the optional `recent_active bool[P, P, G]` plane — the
-    single largest plane damping added — rides bit-packed 32:1 along the
-    group axis (kernels.pack_bits_g, GC008 PACKED_PLANES `bits_g`)
-    between rounds, so a donated double-buffered scan reads/writes ~32x
-    less HBM for it per round.  Undamped states pass through unchanged
-    (None words), keeping the undamped scan graph bit-identical.  Inverse:
-    unpack_ra_carry."""
-    if st.recent_active is None:
+    single largest plane damping added, the registry's packed-carry row —
+    rides bit-packed 32:1 along the group axis (kernels.pack_bits_g,
+    GC008 PACKED_PLANES `bits_g`) between rounds, so a donated
+    double-buffered scan reads/writes ~32x less HBM for it per round.
+    Undamped states pass through unchanged (None words), keeping the
+    undamped scan graph bit-identical.  Inverse: unpack_ra_carry."""
+    plane = getattr(st, _PACKED_CARRY_FIELD)
+    if plane is None:
         return st, None
     return (
-        st._replace(recent_active=None),
-        kernels.pack_bits_g(st.recent_active),
+        st._replace(**{_PACKED_CARRY_FIELD: None}),
+        kernels.pack_bits_g(plane),
     )
 
 
 def unpack_ra_carry(
     st: SimState, words: Optional[jnp.ndarray]
 ) -> SimState:
-    """Inverse of pack_ra_carry: restore the recent_active plane from its
-    packed scan-carry words (None words = undamped state, unchanged)."""
+    """Inverse of pack_ra_carry: restore the packed-carry plane from its
+    scan-carry words (None words = undamped state, unchanged)."""
     if words is None:
         return st
     n_groups = st.term.shape[-1]
     return st._replace(
-        recent_active=kernels.unpack_bits_g(words, n_groups)
+        **{_PACKED_CARRY_FIELD: kernels.unpack_bits_g(words, n_groups)}
     )
 
 

@@ -260,6 +260,76 @@ def host_unpack_bits_g(words: np.ndarray, g: int) -> np.ndarray:
     return flat[..., :g] != 0
 
 
+# Throwaway-clone Inflights window (slots).  Real harness clusters run
+# max_inflight_msgs = 1 << 20 ("effectively unbounded"); clones carry a
+# rebased ring of this size instead so a clone costs microseconds, not
+# a 1M-slot buffer alloc per progress — see _seed_clone_memo.
+_TWIN_CAP = 1 << 14
+
+
+def _seed_clone_memo(net, memo: dict) -> dict:
+    """Seed a deepcopy memo for one group's Network so the copy is exact
+    AND cheap: per-store RLocks (unpicklable — a naive deepcopy raises)
+    are re-seeded fresh, a shared metrics registry is dropped so the
+    clone's pumps can never double-count the live cluster's events, and
+    each Inflights ring — its buffer a flat int list preallocated to
+    max_inflight_msgs (1 << 20 in the harness config), ~10M interned
+    ints per network — is seeded with a rebased twin carrying only the
+    LIVE window [start, start+count): slots outside it are never read
+    before being overwritten (inflights.py's ring discipline), so the
+    twin is observationally exact while skipping the full-buffer copies
+    that made naive clones cost seconds each."""
+    import threading
+
+    for iface in net.peers.values():
+        r = iface.raft
+        if r is None:
+            continue
+        store = getattr(r.raft_log, "store", None)
+        lock = getattr(store, "_lock", None)
+        if lock is not None:
+            memo[id(lock)] = threading.RLock()
+        if r.metrics is not None:
+            memo[id(r.metrics)] = None
+        for _, pr in r.prs.iter():
+            ins = pr.ins
+            # Rebase the twin to start=0 on a small ring: only the live
+            # window is observable (slots outside [start, start+count)
+            # are never read before being overwritten), and the ONLY cap
+            # dependence is full() at count == cap — unreachable below
+            # _TWIN_CAP for any harness schedule (≤ a few hundred
+            # in-flight appends even across a 110-round fuzz run with a
+            # crashed follower).  A genuine backlog falls back to the
+            # real window so full()-parity can never silently change.
+            tcap = min(ins.cap, _TWIN_CAP)
+            if ins.count > tcap // 4:
+                tcap = ins.cap
+            twin = type(ins)(tcap)
+            twin.count = ins.count
+            for i in range(ins.count):
+                twin.buffer[i] = ins.buffer[(ins.start + i) % ins.cap]
+            memo[id(ins)] = twin
+    return memo
+
+
+def clone_cluster(obj):
+    """Memo-seeded deepcopy of a ScalarCluster — or of any oracle
+    holding one as `.cluster` — in milliseconds where a naive deepcopy
+    costs ~16s per clone (ROADMAP's standing tier-1 constraint) or
+    aborts outright on the stores' RLocks.  The parity suites use this
+    to settle ONE master cluster per configuration module-scoped and
+    hand every test its own throwaway copy instead of re-running the
+    settle; ReadOracle's per-probe `_clone_group` is the single-network
+    special case of the same memo seeding."""
+    import copy
+
+    cluster = getattr(obj, "cluster", obj)
+    memo: dict = {}
+    for net in cluster.networks:
+        _seed_clone_memo(net, memo)
+    return copy.deepcopy(obj, memo)
+
+
 class HealthOracle:
     """Scalar-side oracle for the device health planes (sim.HealthState).
 
@@ -598,29 +668,10 @@ class ReadOracle(TransferOracle):
         and a shared metrics registry is dropped from the copy so the
         probe's pump can never double-count the live cluster's events."""
         import copy
-        import threading
 
         net = self.cluster.networks[g]
         memo: dict = {}
-        for iface in net.peers.values():
-            r = iface.raft
-            if r is None:
-                continue
-            store = getattr(r.raft_log, "store", None)
-            lock = getattr(store, "_lock", None)
-            if lock is not None:
-                memo[id(lock)] = threading.RLock()
-            if r.metrics is not None:
-                memo[id(r.metrics)] = None
-            # Inflights ring buffers are flat int lists preallocated to
-            # max_inflight_msgs (1 << 20 in the harness config): a naive
-            # deepcopy walks ~10M interned ints per clone.  Seed each
-            # buffer with a C-level shallow copy instead — ints are
-            # immutable, so the copy is exact and the live buffers can
-            # never be written through it.
-            for _, pr in r.prs.iter():
-                buf = pr.ins.buffer
-                memo[id(buf)] = list(buf)
+        _seed_clone_memo(net, memo)
         return copy.deepcopy(net, memo)
 
     def read_probe(self, g: int, crashed_row, link_col, mode: int) -> tuple:

@@ -406,7 +406,7 @@ def make_runner(
     with_bb = cfg.blackbox
 
     def run(st, hl, rst, rcar, *args):
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             bb, sched_args = args[0], args[1:]
         else:
             sched_args = args
@@ -430,14 +430,14 @@ def make_runner(
         carry = (
             st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
         )
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             carry = carry + (bb,)
         carry, _ = jax.lax.scan(
             body,
             carry,
             jnp.arange(n_rounds, dtype=jnp.int32),
         )
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             carry, bb = carry[:-1], carry[-1]
         stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats, lat_hist = (
             carry
@@ -445,7 +445,7 @@ def make_runner(
         # The same tail audit as reconfig.make_runner: a final-round
         # apply's mask transition is checked one round later, so fold
         # once more on the final state (commit checks inert).
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
+        if with_bb:
             viol = kernels.check_safety_groups(
                 stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
                 stf.commit,

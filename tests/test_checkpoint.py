@@ -50,106 +50,10 @@ def test_hard_states_shape(tmp_path):
     assert (hs["commit"].max(axis=0) >= 1).all()
 
 
-def test_checkpoint_damped_plane_round_trip(tmp_path):
-    """The optional recent_active plane (SimConfig damping, ISSUE 7)
-    round-trips: present -> restored bit-exactly, absent -> None, and a
-    checkpoint missing a REQUIRED plane fails loudly.  State is built
-    without stepping (init + direct plane writes) so this stays
-    compile-free tier-1."""
-    from raft_tpu.multiraft import sim as sim_mod
-
-    cfg = SimConfig(n_groups=4, n_peers=3, check_quorum=True, pre_vote=True)
-    st = sim_mod.init_state(cfg)
-    assert st.recent_active is not None
-    st = st._replace(
-        recent_active=st.recent_active.at[0, 1, :].set(True),
-        term=st.term.at[0].set(3),
-    )
-    path = os.path.join(tmp_path, "damped.npz")
-    save_state(st, path)
-    back = load_state(path)
-    for f in st._fields:
-        a, b = getattr(st, f), getattr(back, f)
-        assert (a is None) == (b is None), f
-        if a is not None:
-            np.testing.assert_array_equal(
-                np.asarray(a), np.asarray(b), err_msg=f"field {f}"
-            )
-    assert np.asarray(back.recent_active).dtype == np.bool_
-
-    # Undamped: the plane is skipped on save and restored as None.
-    st0 = sim_mod.init_state(SimConfig(n_groups=4, n_peers=3))
-    path0 = os.path.join(tmp_path, "plain.npz")
-    save_state(st0, path0)
-    assert load_state(path0).recent_active is None
-
-    # A required plane missing is corruption, not an optional skip.
-    with np.load(path0) as data:
-        arrays = {k: data[k] for k in data.files if k != "commit"}
-    broken = os.path.join(tmp_path, "broken.npz")
-    with open(broken, "wb") as f:
-        np.savez(f, **arrays)
-    with pytest.raises(ValueError, match="missing required plane"):
-        load_state(broken)
-
-
-def test_read_state_round_trip_and_corruption(tmp_path):
-    """The client-read protocol carry (ISSUE 13) round-trips bit-exactly
-    — outstanding-read planes + stats + latency histogram — and every
-    corruption mode fails loudly: wrong file kind, bad version, missing
-    plane.  Compile-free tier-1 (direct plane writes)."""
-    import numpy as np
-
-    from raft_tpu.multiraft import workload
-    from raft_tpu.multiraft.checkpoint import (
-        load_read_state,
-        save_read_state,
-        save_state,
-    )
-    from raft_tpu.multiraft import sim as sim_mod
-
-    G = 7
-    rcar = workload.ReadCarry(
-        pending_mode=jnp.asarray(np.arange(G) % 3, jnp.int32),
-        pending_since=jnp.asarray(np.arange(G) * 5, jnp.int32),
-    )
-    stats = jnp.asarray(
-        np.arange(workload.N_READ_STATS) * 11, jnp.int32
-    )
-    hist = jnp.asarray(
-        np.arange(workload.N_LAT_BUCKETS) % 4, jnp.int32
-    )
-    path = os.path.join(tmp_path, "reads.npz")
-    save_read_state(rcar, stats, hist, path)
-    rcar2, stats2, hist2 = load_read_state(path)
-    np.testing.assert_array_equal(
-        np.asarray(rcar.pending_mode), np.asarray(rcar2.pending_mode)
-    )
-    np.testing.assert_array_equal(
-        np.asarray(rcar.pending_since), np.asarray(rcar2.pending_since)
-    )
-    np.testing.assert_array_equal(np.asarray(stats), np.asarray(stats2))
-    np.testing.assert_array_equal(np.asarray(hist), np.asarray(hist2))
-    # Wrong file kind: a SimState checkpoint is not a read checkpoint.
-    other = os.path.join(tmp_path, "state.npz")
-    save_state(sim_mod.init_state(SimConfig(n_groups=2, n_peers=3)), other)
-    with pytest.raises(ValueError, match="not a read-state checkpoint"):
-        load_read_state(other)
-    # Unsupported version.
-    with np.load(path) as data:
-        arrays = {k: data[k] for k in data.files}
-    arrays["__read_version__"] = np.asarray(999)
-    bad = os.path.join(tmp_path, "bad.npz")
-    np.savez(bad, **arrays)
-    with pytest.raises(ValueError, match="version 999"):
-        load_read_state(bad)
-    # Missing plane = corruption.
-    del arrays["lat_hist"]
-    arrays["__read_version__"] = np.asarray(1)
-    trunc = os.path.join(tmp_path, "trunc.npz")
-    np.savez(trunc, **arrays)
-    with pytest.raises(ValueError, match="missing plane 'lat_hist'"):
-        load_read_state(trunc)
+# (Per-plane checkpoint round-trips — the damped recent_active plane,
+# the read-protocol carry, and their corruption modes — moved to the
+# registry-driven tests/test_planes_registry.py, which parameterizes
+# over every persisted row of raft_tpu/multiraft/planes.py.)
 
 
 def test_pack_ra_carry_round_trip():

@@ -374,9 +374,11 @@ def test_monitor_record_incident_and_rename():
     entry = mon.record_incident(inc)
     assert entry["incident"] is inc
     assert mon.incidents() == [inc]
-    # summary_ring is the canonical name; flight_recorder the
-    # deprecated alias (same contents).
-    assert mon.summary_ring() == mon.flight_recorder()
+    # summary_ring is the only name (the deprecated flight_recorder
+    # alias was removed; the flight-recorder role belongs to the
+    # device black box).
+    assert mon.summary_ring()[-1] is entry
+    assert not hasattr(mon, "flight_recorder")
     snap = m.registry.snapshot()
     key = 'multiraft_safety_incidents_total{slot="stale_read"}'
     assert snap[key] == 2

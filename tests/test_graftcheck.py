@@ -1699,3 +1699,346 @@ def test_rule_filter_keeps_trace_build_errors(tmp_path, monkeypatch, capsys):
     rc = gm.main(["--trace", "--rule", "GC011", "raft_tpu"])
     assert rc == 1
     assert "trace-build-error" in capsys.readouterr().out
+
+
+# --- PR 17 registry rules: GC016 registry-closure + GC017 stale-marker
+
+
+# A minimal-but-complete fixture registry: GC016 standalone-loads the
+# SCANNED planes.py, so every accessor check_registry calls must exist.
+# `{ghost_extra}` lets tests vary the gated row (oracle, etc).
+_FIXTURE_PLANES = '''\
+from typing import NamedTuple, Optional, Tuple
+
+
+class PlaneSpec(NamedTuple):
+    name: str
+    owner: str
+    family: str
+    shape: str
+    dtype: str
+    flag: Tuple[str, ...] = ()
+    bound_bits: Optional[int] = None
+    bound: str = ""
+    packing: str = "none"
+    checkpoint: str = "none"
+    sharding: str = "none"
+    steady: str = "fusable"
+    oracle: Optional[str] = None
+
+
+REGISTRY = (
+    PlaneSpec("term", "SimState", "core", "[P, G]", "int32",
+              checkpoint="state", sharding="minor-G"),
+    PlaneSpec("ghost", "SimState", "core", "[P, G]", "bool",
+              flag=("damp",), checkpoint="state",
+              sharding="minor-G"{ghost_extra}),
+)
+
+
+def rows(owner=None, family=None):
+    return tuple(
+        r for r in REGISTRY
+        if (owner is None or r.owner == owner)
+        and (family is None or r.family == family)
+    )
+
+
+def row(owner, name):
+    for r in REGISTRY:
+        if r.owner == owner and r.name == name:
+            return r
+    raise KeyError((owner, name))
+
+
+def sim_state_fields():
+    return tuple(r.name for r in rows(owner="SimState"))
+
+
+def optional_sim_fields():
+    return tuple(r.name for r in rows(owner="SimState") if r.flag)
+
+
+def checkpoint_fields(policy):
+    return tuple(r.name for r in REGISTRY if r.checkpoint == policy)
+
+
+def packed_carry_fields():
+    return tuple(
+        r.name for r in rows(owner="SimState") if r.packing == "bits_g"
+    )
+
+
+def steady_defuse_flags():
+    out = []
+    for r in REGISTRY:
+        if r.steady == "defuse":
+            for f in r.flag:
+                if f not in out:
+                    out.append(f)
+    return tuple(out)
+
+
+def gating_flags():
+    out = []
+    for r in REGISTRY:
+        for f in r.flag:
+            if f not in out:
+                out.append(f)
+    return tuple(out)
+
+
+def leading_axes(r):
+    return r.shape.count(",")
+'''
+
+_FIXTURE_SIM = '''\
+"""fixture sim"""
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+class SimConfig(NamedTuple):
+    n_groups: int = 1
+    damp: bool = False
+
+
+class SimState(NamedTuple):
+    term: jnp.ndarray  # gc: int32[P, G]
+    ghost: Optional[jnp.ndarray] = None  # gc: bool[P, G]
+
+
+# carry packing derives from planes.packed_carry_fields (consumption pin)
+'''
+
+
+def planes_fixture(ghost_extra=""):
+    return _FIXTURE_PLANES.format(ghost_extra=ghost_extra)
+
+
+def gc016(vs):
+    return [v for v in vs if v.rule_id == "GC016"]
+
+
+def test_gc016_matching_tree_passes(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": _FIXTURE_SIM,
+        },
+    )
+    assert gc016(vs) == []
+
+
+def test_gc016_simstate_field_order_mismatch_flags(tmp_path):
+    # Dropping the gated field desyncs SimState from the registry rows.
+    sim = _FIXTURE_SIM.replace(
+        "    ghost: Optional[jnp.ndarray] = None  # gc: bool[P, G]\n", ""
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": sim,
+        },
+    )
+    assert any("SimState fields" in v.message for v in gc016(vs))
+
+
+def test_gc016_anchor_dtype_mismatch_flags(tmp_path):
+    sim = _FIXTURE_SIM.replace("# gc: int32[P, G]", "# gc: bool[P, G]")
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": sim,
+        },
+    )
+    assert any("anchor" in v.message for v in gc016(vs))
+
+
+def test_gc016_gated_field_must_be_optional(tmp_path):
+    sim = _FIXTURE_SIM.replace(
+        "term: jnp.ndarray  # gc: int32[P, G]\n"
+        "    ghost: Optional[jnp.ndarray] = None  # gc: bool[P, G]",
+        "term: jnp.ndarray  # gc: int32[P, G]\n"
+        "    ghost: jnp.ndarray  # gc: bool[P, G]",
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": sim,
+        },
+    )
+    assert any("flag-gated" in v.message for v in gc016(vs))
+
+
+def test_gc016_gating_flag_must_exist_in_simconfig(tmp_path):
+    sim = _FIXTURE_SIM.replace("    damp: bool = False\n", "")
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": sim,
+        },
+    )
+    assert any("not a SimConfig field" in v.message for v in gc016(vs))
+
+
+def test_gc016_oracle_must_resolve(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(
+                ghost_extra=', oracle="simref.NoSuchOracle"'
+            ),
+            "raft_tpu/multiraft/sim.py": _FIXTURE_SIM,
+            "raft_tpu/multiraft/simref.py": '"""x"""\n\nclass Other:\n    pass\n',
+        },
+    )
+    assert any("does not resolve" in v.message for v in gc016(vs))
+
+
+def test_gc016_overflow_drift_flags(tmp_path):
+    # A fixture linter checkout whose overflow.py regrew a local dict:
+    # the drift check reads repo_root/tools/..., which run_engine_on
+    # points at tmp_path.
+    bad = tmp_path / "tools" / "graftcheck" / "engine" / "overflow.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text('COUNTER_PLANES = {"CTR_X"}\n')
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": _FIXTURE_SIM,
+        },
+    )
+    msgs = [v.message for v in gc016(vs)]
+    assert any("local literal" in m for m in msgs)
+    assert any("no longer binds" in m for m in msgs)
+
+
+def test_gc016_checkpoint_literal_family_flags(tmp_path):
+    ckpt = (
+        '"""fixture checkpoint"""\n'
+        "from . import planes\n\n"
+        "_STATE = planes.checkpoint_fields(\"state\")\n"
+        "_OPT = planes.optional_sim_fields()\n"
+        'BYPASS = ["ghost"]\n'
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/planes.py": planes_fixture(),
+            "raft_tpu/multiraft/sim.py": _FIXTURE_SIM,
+            "raft_tpu/multiraft/checkpoint.py": ckpt,
+        },
+    )
+    assert any("re-enumerates" in v.message for v in gc016(vs))
+
+
+def gc017(vs):
+    return [v for v in vs if v.rule_id == "GC017"]
+
+
+def test_gc017_stale_marker_flags(tmp_path):
+    # The dtype IS explicit, so the GC001 suppression earns nothing.
+    src = (
+        '"""m <-> o"""\n'
+        "import jax.numpy as jnp\n\n"
+        f"x = jnp.zeros((4,), dtype=jnp.int32)  {MARK}no-implicit-dtype — obsolete\n"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    assert any(v.line == 4 for v in gc017(vs))
+
+
+def test_gc017_live_marker_passes(tmp_path):
+    src = (
+        '"""m <-> o"""\n'
+        "import jax.numpy as jnp\n\n"
+        f"x = jnp.zeros((4,))  {MARK}no-implicit-dtype — fixture wants weak typing\n"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    assert gc017(vs) == []
+
+
+def test_gc017_trace_rule_marker_exempt(tmp_path):
+    # GC011-GC015 liveness needs the lowered graphs (jax); the engine run
+    # must not call their markers stale.
+    src = (
+        '"""m <-> o"""\n'
+        "import jax.numpy as jnp\n\n"
+        f"{MARK}GC014 — budget exception justified elsewhere\n"
+        "x = jnp.zeros((4,), dtype=jnp.int32)\n"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    assert gc017(vs) == []
+
+
+def test_gc017_marker_in_string_literal_ignored(tmp_path):
+    src = (
+        '"""m <-> o"""\n'
+        "import jax.numpy as jnp\n\n"
+        f'FIXTURE = """y = 1  {MARK}no-implicit-dtype — embedded fixture"""\n'
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/kernels.py": src})
+    assert gc017(vs) == []
+
+
+def test_gc017_unconsulted_anchor_flags(tmp_path):
+    # A module-level assignment's anchor is never read by the engine
+    # interpreter — the claim is decorative.
+    src = (
+        '"""fixture sim"""\n'
+        "import jax.numpy as jnp\n\n"
+        "X = 4  # gc" + ": int32[P, G]\n"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/sim.py": src})
+    assert any("anchor" in v.message for v in gc017(vs))
+
+
+def test_gc017_consulted_anchor_passes(tmp_path):
+    src = (
+        '"""fixture sim"""\n'
+        "import jax.numpy as jnp\n\n\n"
+        "def f(x):  # gc" + ": int32[P, G]\n"
+        "    y = x  # gc" + ": int32[P, G]\n"
+        "    return y\n"
+    )
+    vs = run_engine_on(tmp_path, {"raft_tpu/multiraft/sim.py": src})
+    assert gc017(vs) == []
+
+
+def test_gc017_fix_markers_rewrites_files(tmp_path):
+    from tools.graftcheck.engine import run_stale_scan
+    from tools.graftcheck.engine.stale import fix_files
+
+    src = (
+        '"""m <-> o"""\n'
+        "import jax.numpy as jnp\n\n"
+        f"x = jnp.zeros((4,), dtype=jnp.int32)  {MARK}no-implicit-dtype — obsolete\n"
+        f"{MARK}no-host-sync-in-jit — a standalone stale marker whose\n"
+        "# justification wraps onto this second comment line\n"
+        "y = jnp.zeros((2,), dtype=jnp.int32)\n"
+    )
+    f = tmp_path / "raft_tpu" / "multiraft" / "kernels.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(src)
+    stub = tmp_path / "tests" / "test_sim_parity.py"
+    stub.parent.mkdir(parents=True)
+    stub.write_text("# parity suite stub\n")
+    ctx = Context(
+        repo_root=tmp_path, tests_root=tmp_path / "tests",
+        reference_root=None,
+    )
+    items = run_stale_scan([str(tmp_path / "raft_tpu")], ctx)
+    assert len(items) == 2
+    fix_files(items)
+    out = f.read_text()
+    assert "graftcheck" not in out
+    assert "justification wraps" not in out
+    assert "x = jnp.zeros((4,), dtype=jnp.int32)\n" in out
+    assert "y = jnp.zeros((2,), dtype=jnp.int32)\n" in out

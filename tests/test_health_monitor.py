@@ -44,9 +44,10 @@ def test_monitor_ring_and_seq():
     assert len(mon) == 3
     assert [e["seq"] for e in ring] == [2, 3, 4]  # oldest evicted
     assert mon.last()["summary"]["counts"]["leaderless"] == 4
-    # The historical name survives as a deprecated alias (ISSUE 15
-    # moved the flight-recorder role to the device black box).
-    assert mon.flight_recorder() == ring
+    # The historical flight_recorder() alias is gone: summary_ring is
+    # the one name (the flight-recorder role lives in the device black
+    # box, SimConfig.blackbox / ClusterSim.forensics()).
+    assert not hasattr(mon, "flight_recorder")
 
 
 def test_monitor_metrics_and_traces():

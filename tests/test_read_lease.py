@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import pytest
 
 from raft_tpu.multiraft import ScalarCluster, SimConfig, kernels, sim
-from raft_tpu.multiraft.simref import ReadOracle
+from raft_tpu.multiraft.simref import ReadOracle, clone_cluster
 
 
 def _masks(G, P, voters, outgoing, learners):
@@ -189,13 +189,42 @@ def settle(oracle, st, step_fn, G, P, rounds=25, append=1, damped=True):
     return st, crashed
 
 
+_SETTLED = {}
+
+
+def settled_pair(G, P, rounds=25, damped=True, **build_kw):
+    """Settle ONE master (oracle, state) per configuration, cached
+    module-scoped; each caller gets the (immutable) settled device state
+    plus a throwaway memo-seeded clone of the oracle
+    (simref.clone_cluster — ROADMAP's standing constraint prices the
+    naive re-settle/deepcopy alternative at ~16s each).  The master
+    itself is never handed out, so no test can perturb another's
+    starting point."""
+    key = (
+        G, P, rounds, damped,
+        tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in build_kw.items()
+        )),
+    )
+    hit = _SETTLED.get(key)
+    if hit is None:
+        oracle, cfg, st, step_fn = build_pair(G, P, **build_kw)
+        st, _ = settle(
+            oracle, st, step_fn, G, P, rounds=rounds, damped=damped
+        )
+        hit = _SETTLED[key] = (oracle, cfg, st, step_fn)
+    oracle, cfg, st, step_fn = hit
+    return clone_cluster(oracle), cfg, st, step_fn
+
+
 def test_lease_serves_locally_steady():
     """Settled check-quorum cluster: every lease read serves at the
     leader's commit with zero message rounds; Safe reads return the same
     index through the quorum round; parity incl. the receipts' flags."""
     G, P = 2, 3
-    oracle, cfg, st, step_fn = build_pair(G, P, check_quorum=True)
-    st, crashed = settle(oracle, st, step_fn, G, P)
+    oracle, cfg, st, step_fn = settled_pair(G, P, check_quorum=True)
+    crashed = np.zeros((G, P), bool)
     app = np.ones(G, np.int64)
     for mode in (sim.READ_LEASE, sim.READ_SAFE):
         modes = np.full(G, mode, np.int32)
@@ -218,8 +247,8 @@ def test_lease_survives_crashed_quorum_until_boundary():
     it, then reads return -1.  Safe reads fail immediately (no ack
     quorum).  Receipt parity every round across the flip."""
     G, P = 2, 3
-    oracle, cfg, st, step_fn = build_pair(G, P, check_quorum=True)
-    st, crashed = settle(oracle, st, step_fn, G, P)
+    oracle, cfg, st, step_fn = settled_pair(G, P, check_quorum=True)
+    crashed = np.zeros((G, P), bool)
     snap = oracle.cluster.snapshot()
     for g in range(G):
         lead = int(snap["state"][g].argmax())
@@ -256,10 +285,10 @@ def test_transfer_pending_degrades_lease():
     then read in lease mode — receipt must be degraded=True and served
     through the quorum round, matching the oracle's Safe pump."""
     G, P = 2, 3
-    oracle, cfg, st, step_fn = build_pair(
+    oracle, cfg, st, step_fn = settled_pair(
         G, P, check_quorum=True, transfer=True
     )
-    st, crashed = settle(oracle, st, step_fn, G, P)
+    crashed = np.zeros((G, P), bool)
     snap = oracle.cluster.snapshot()
     app = np.zeros(G, np.int64)
     # Pick a target and crash it, so the catch-up/TimeoutNow never lands.
@@ -304,10 +333,10 @@ def test_joint_self_quorum_lease_serves_where_safe_hangs():
     but the LEASE serves: LeaseBased never waits for acks.  The batched
     gate and the scalar pump must agree on both arms."""
     G, P = 2, 2
-    oracle, cfg, st, step_fn = build_pair(
-        G, P, check_quorum=True, voters=[2], outgoing=[2]
+    oracle, cfg, st, step_fn = settled_pair(
+        G, P, rounds=30, check_quorum=True, voters=[2], outgoing=[2]
     )
-    st, crashed = settle(oracle, st, step_fn, G, P, rounds=30)
+    crashed = np.zeros((G, P), bool)
     app = np.ones(G, np.int64)
     for mode, want_served in ((sim.READ_SAFE, False), (sim.READ_LEASE, True)):
         modes = np.full(G, mode, np.int32)
@@ -325,8 +354,10 @@ def test_undamped_lease_request_degrades():
     configuration outright); every READ_LEASE request degrades to the
     ReadIndex round, bit-identically on both sides."""
     G, P = 2, 3
-    oracle, cfg, st, step_fn = build_pair(G, P, check_quorum=False)
-    st, crashed = settle(oracle, st, step_fn, G, P, damped=False)
+    oracle, cfg, st, step_fn = settled_pair(
+        G, P, damped=False, check_quorum=False
+    )
+    crashed = np.zeros((G, P), bool)
     app = np.ones(G, np.int64)
     modes = np.full(G, sim.READ_LEASE, np.int32)
     st, receipt = step_fn(

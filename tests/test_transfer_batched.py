@@ -21,11 +21,20 @@ import jax.numpy as jnp
 from raft_tpu.multiraft import kernels
 from raft_tpu.multiraft import sim
 from raft_tpu.multiraft.sim import SimConfig
-from raft_tpu.multiraft.simref import ScalarCluster, TransferOracle
+from raft_tpu.multiraft.simref import ScalarCluster, TransferOracle, clone_cluster
 
 G, P = 8, 3
 
 _STEP_CACHE = {}
+
+# Every tier-1/fuzz schedule in this module is null (no transfer, kick,
+# link, or crash) through its leader-election warmup, so run_parity
+# replays rounds [0, WARM_ROUNDS) ONCE per configuration and hands each
+# test a memo-seeded clone of the warmed oracle (simref.clone_cluster —
+# ROADMAP's standing constraint: share the ~16s deepcopies
+# module-scoped) plus the immutable device state/health pytrees.
+WARM_ROUNDS = 14
+_WARM_CACHE = {}
 
 
 def _step_for(cfg: SimConfig):
@@ -37,21 +46,53 @@ def _step_for(cfg: SimConfig):
     return fn
 
 
-def run_parity(
-    schedule,
-    rounds,
-    g=G,
-    p=P,
-    damped=False,
-    voters=None,
-    learners=None,
-    check_transferee=True,
-):
-    """Drive identical schedules through the transfer-enabled device step
-    and the TransferOracle; assert exact per-round state + health (+
-    lead_transferee) parity.  `schedule(r, st, crashed_h)` returns
-    (transfer_propose[G] | None, kick[G, P] | None, link[P, P, G] | None,
-    crashed[G, P])."""
+def _parity_round(step, st, hl, orc, r, schedule, check_transferee, g, p):
+    """One lockstep round + the per-round parity asserts.  The device
+    call always passes concrete transfer_propose/kick/link planes (the
+    module's ONE canonical traced signature per configuration: None and
+    the neutral plane are behavior-identical — step() substitutes zeros
+    for None itself — but each None/array combination is its own jit
+    trace, and the retraces used to dominate this suite's tier-1 bill)."""
+    crashed_h = np.zeros((g, p), bool)
+    tp, kick, link, crashed_h = schedule(r, st, crashed_h)
+    append_h = np.ones((g,), np.int64)
+    st, hl = step(
+        st,
+        jnp.asarray(crashed_h.T),
+        jnp.asarray(append_h, dtype=jnp.int32),
+        health=hl,
+        transfer_propose=jnp.zeros((g,), jnp.int32)
+        if tp is None else jnp.asarray(tp),
+        campaign_kick=jnp.zeros((p, g), bool)
+        if kick is None else jnp.asarray(kick.T),
+        link=jnp.ones((p, p, g), bool)
+        if link is None else jnp.asarray(link),
+    )
+    orc.round(
+        crashed=crashed_h, append_n=append_h, link=link,
+        transfer_propose=tp, kick=kick,
+    )
+    snap = orc.cluster.snapshot()
+    for k in ("term", "state", "commit", "last_index", "last_term"):
+        dev = np.asarray(getattr(st, k)).T
+        assert np.array_equal(dev, snap[k]), (
+            f"round {r}: {k} diverged\ndev=\n{dev}\norc=\n{snap[k]}"
+        )
+    if check_transferee:
+        assert np.array_equal(
+            np.asarray(st.transferee).T, orc.pending()
+        ), f"round {r}: lead_transferee diverged"
+    assert np.array_equal(
+        np.asarray(orc.planes), np.asarray(hl.planes)
+    ), f"round {r}: health planes diverged"
+    return st, hl
+
+
+def _null_schedule(r, st, crashed_h):
+    return None, None, None, crashed_h
+
+
+def _fresh_pair(g, p, damped, voters, learners):
     cfg = SimConfig(
         n_groups=g, n_peers=p, collect_health=True, transfer=True,
         check_quorum=damped, pre_vote=damped,
@@ -72,41 +113,47 @@ def run_parity(
         voters=voters, learners=learners,
     )
     orc = TransferOracle(cl, window=cfg.health_window)
-    step = _step_for(cfg)
-    append_h = np.ones((g,), np.int64)
-    for r in range(rounds):
-        crashed_h = np.zeros((g, p), bool)
-        tp, kick, link, crashed_h = schedule(r, st, crashed_h)
-        kw = {}
-        if link is not None:
-            kw["link"] = jnp.asarray(link)
-        st, hl = step(
-            st,
-            jnp.asarray(crashed_h.T),
-            jnp.asarray(append_h, dtype=jnp.int32),
-            health=hl,
-            transfer_propose=None if tp is None else jnp.asarray(tp),
-            campaign_kick=None if kick is None else jnp.asarray(kick.T),
-            **kw,
-        )
-        orc.round(
-            crashed=crashed_h, append_n=append_h, link=link,
-            transfer_propose=tp, kick=kick,
-        )
-        snap = cl.snapshot()
-        for k in ("term", "state", "commit", "last_index", "last_term"):
-            dev = np.asarray(getattr(st, k)).T
-            assert np.array_equal(dev, snap[k]), (
-                f"round {r}: {k} diverged\ndev=\n{dev}\norc=\n{snap[k]}"
+    return st, hl, orc, _step_for(cfg)
+
+
+def run_parity(
+    schedule,
+    rounds,
+    g=G,
+    p=P,
+    damped=False,
+    voters=None,
+    learners=None,
+    check_transferee=True,
+):
+    """Drive identical schedules through the transfer-enabled device step
+    and the TransferOracle; assert exact per-round state + health (+
+    lead_transferee) parity.  `schedule(r, st, crashed_h)` returns
+    (transfer_propose[G] | None, kick[G, P] | None, link[P, P, G] | None,
+    crashed[G, P]); it MUST be null before WARM_ROUNDS — the warmup is
+    replayed once per configuration and shared (parity asserted while
+    the master is built, skipped on cache hits)."""
+    key = (
+        g, p, damped,
+        tuple(voters or ()), tuple(learners or ()), check_transferee,
+    )
+    assert rounds >= WARM_ROUNDS, "schedules must be null pre-warmup"
+    warm = _WARM_CACHE.get(key)
+    if warm is None:
+        st, hl, orc, step = _fresh_pair(g, p, damped, voters, learners)
+        for r in range(WARM_ROUNDS):
+            st, hl = _parity_round(
+                step, st, hl, orc, r, _null_schedule, check_transferee,
+                g, p,
             )
-        if check_transferee:
-            assert np.array_equal(
-                np.asarray(st.transferee).T, orc.pending()
-            ), f"round {r}: lead_transferee diverged"
-        assert np.array_equal(
-            np.asarray(orc.planes), np.asarray(hl.planes)
-        ), f"round {r}: health planes diverged"
-    return st, cl, orc
+        warm = _WARM_CACHE[key] = (st, hl, orc, step)
+    st, hl, master_orc, step = warm
+    orc = clone_cluster(master_orc)
+    for r in range(WARM_ROUNDS, rounds):
+        st, hl = _parity_round(
+            step, st, hl, orc, r, schedule, check_transferee, g, p
+        )
+    return st, orc.cluster, orc
 
 
 def _targets_for(st, swap=(2, 1)):
@@ -435,22 +482,8 @@ def test_steady_mask_rejects_pending_transfer():
     assert masked.tolist() == [True, False, True, True]
 
 
-def test_checkpoint_roundtrips_transferee(tmp_path):
-    from raft_tpu.multiraft import checkpoint
-
-    cfg = SimConfig(n_groups=4, n_peers=3, transfer=True)
-    st = sim.init_state(cfg)
-    tr = np.zeros((3, 4), np.int32)
-    tr[1, 2] = 3
-    st = st._replace(transferee=jnp.asarray(tr))
-    path = str(tmp_path / "st.npz")
-    checkpoint.save_state(st, path)
-    st2 = checkpoint.load_state(path)
-    assert np.array_equal(np.asarray(st2.transferee), tr)
-    # transfer-off states keep the optional plane absent
-    st0 = sim.init_state(SimConfig(n_groups=4, n_peers=3))
-    checkpoint.save_state(st0, path)
-    assert checkpoint.load_state(path).transferee is None
+# (The transferee checkpoint round-trip moved to the registry-driven
+# tests/test_planes_registry.py, which covers every persisted plane.)
 
 
 # --- slow: fuzz + scale ----------------------------------------------------

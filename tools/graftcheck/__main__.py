@@ -30,7 +30,7 @@ from typing import List, Optional, Set, Tuple
 
 from . import cache as cache_mod
 from .core import Context, Violation, run_paths
-from .engine import extract_obligations, run_engine
+from .engine import extract_obligations, run_engine, run_stale_scan
 from .rules import all_rules
 
 
@@ -230,6 +230,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the GC010 parity-obligations JSON to PATH and exit",
     )
     ap.add_argument(
+        "--fix-markers",
+        action="store_true",
+        help="remove every GC017-stale allow marker / `# gc:` anchor from "
+        "the scanned paths in place, then exit (runs the engine layer to "
+        "prove staleness first)",
+    )
+    ap.add_argument(
         "--no-cache",
         action="store_true",
         help="bypass the mtime-keyed run cache (.graftcheck-cache.json)",
@@ -319,6 +326,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         ),
         reference_root=ref_root,
     )
+
+    if args.fix_markers:
+        from .engine import stale as stale_mod
+
+        items = run_stale_scan(args.paths, ctx)
+        if not items:
+            print("graftcheck: no stale markers/anchors found")
+            return 0
+        fixed = stale_mod.fix_files(items)
+        for item in items:
+            label = "marker" if item.kind == "marker" else "anchor"
+            print(f"{item.path}:{item.line}: removed stale {label} ({item.detail})")
+        total = sum(fixed.values())
+        print(
+            f"graftcheck: removed {total} stale marker(s)/anchor(s) across "
+            f"{len(fixed)} file(s)"
+        )
+        return 0
 
     if args.emit_obligations:
         extracted = extract_obligations(args.paths, ctx)

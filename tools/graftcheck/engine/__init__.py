@@ -22,10 +22,17 @@ from ..core import (
 )
 from . import obligations as obligations_mod
 from . import overflow
+from . import registry as registry_mod
+from . import stale as stale_mod
 from .interp import build_program
 from .rules import check_traced_escape, engine_rules
 
-__all__ = ["run_engine", "extract_obligations", "engine_rules"]
+__all__ = [
+    "run_engine",
+    "run_stale_scan",
+    "extract_obligations",
+    "engine_rules",
+]
 
 
 def _load_files(paths: Sequence[str]) -> List[SourceFile]:
@@ -40,8 +47,12 @@ def _load_files(paths: Sequence[str]) -> List[SourceFile]:
     return out
 
 
-def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
-    files = _load_files(paths)
+def _engine_raw(
+    files: List[SourceFile], ctx: Context
+) -> List[Violation]:
+    """The engine layer's PRE-suppression violations (GC007-GC010 +
+    GC016) — GC017's staleness audit needs them raw, before allow
+    markers filter anything."""
     violations: List[Violation] = []
 
     # GC007: whole-program shape/dtype inference.
@@ -71,6 +82,26 @@ def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
             obligations_mod.check_baseline(kernels_sf, ctx, document)
         )
 
+    # GC016: plane-registry closure.
+    violations.extend(registry_mod.check_registry(files, ctx))
+    return violations
+
+
+def _all_rules() -> List:
+    from ..rules import all_rules  # lazy: rules package imports us back
+
+    return all_rules()
+
+
+def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
+    files = _load_files(paths)
+    violations = _engine_raw(files, ctx)
+
+    # GC017: stale suppressions, judged against the raw violation set
+    # (engine layer above + a raw per-file re-run inside find_stale).
+    stale_items = stale_mod.find_stale(files, ctx, violations, _all_rules())
+    violations.extend(stale_mod.stale_violations(stale_items))
+
     # Allow-marker suppression (GC000 validation already happened in the
     # per-file run over the same files).
     by_path: Dict[str, List[Violation]] = defaultdict(list)
@@ -88,6 +119,14 @@ def run_engine(paths: Sequence[str], ctx: Context) -> List[Violation]:
         kept.extend(apply_markers(sf, vs, rules, markers, emit_gc000=False))
     kept.sort(key=lambda v: (v.path, v.line, v.rule_id))
     return kept
+
+
+def run_stale_scan(paths: Sequence[str], ctx: Context):
+    """The --fix-markers entry point: every stale marker/anchor in the
+    scanned paths, as structured items for the fixer."""
+    files = _load_files(paths)
+    raw = _engine_raw(files, ctx)
+    return stale_mod.find_stale(files, ctx, raw, _all_rules())
 
 
 def extract_obligations(

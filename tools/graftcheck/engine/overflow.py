@@ -29,6 +29,8 @@ silently stretching a bound.
 from __future__ import annotations
 
 import ast
+import importlib.util
+from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Set
 
 from ..core import SourceFile, Violation
@@ -36,161 +38,52 @@ from ..core import SourceFile, Violation
 GC008 = "GC008"
 GC008_SLUG = "plane-overflow"
 
-# Declared per-round per-counter event budget: the `256` in ClusterSim's
-# _drain_cap expression.  events/window <= window * BUDGET_PER_GROUP * G.
-BUDGET_PER_GROUP = 256
-# int32 wrap exponent: windows must keep total events <= 2**31.
-WRAP_SHIFT = 31
 
-# Registered counter plane rows (kernels.CTR_*).
-COUNTER_PLANES: Set[str] = {
-    "CTR_CAMPAIGNS",
-    "CTR_HEARTBEATS",
-    "CTR_ELECTIONS_WON",
-    "CTR_COMMIT_ENTRIES",
-}
+def _load_planes():
+    """Load raft_tpu/multiraft/planes.py STANDALONE (by file path): the
+    registry module is stdlib-only by contract, but importing it through
+    the package would pull jax via raft_tpu.multiraft.__init__ — and
+    graftcheck's AST/engine layers must stay zero-dependency.  GC016
+    (registry-closure) is what keeps this loader honest: it fails the
+    build if overflow.py regrows local copies of the registries below."""
+    path = (
+        Path(__file__).resolve().parents[3]
+        / "raft_tpu" / "multiraft" / "planes.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "_graftcheck_plane_registry", path
+    )
+    assert spec is not None and spec.loader is not None, path
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
-# Registered health plane rows (kernels.HP_*) -> max additive growth per
-# round.  All four are +1/round (resets only shrink), giving a wrap
-# horizon of 2**31 rounds — out of model, like the commit plane itself.
-HEALTH_PLANES: Dict[str, int] = {
-    "HP_LEADERLESS": 1,
-    "HP_SINCE_COMMIT": 1,
-    "HP_TERM_BUMPS": 1,
-    "HP_VOTE_SPLITS": 1,
-}
 
-# Names inside update_health whose values are DECLARED bounded (<= bound)
-# with the derivation documented in docs/STATIC_ANALYSIS.md rather than
-# proven from this AST.  term_bump: a group's max term grows by at most 1
-# per round (each campaigner adds exactly 1 to its own term and every bump
-# target adopts an existing campaigner's term).
-DECLARED_BOUNDED: Dict[str, int] = {"term_bump": 1}
+_planes = _load_planes()
 
-# Registered packed-plane encodings: every sub-int32 value that rides in a
-# shared word must appear here with its bit budget and the derivation of
-# the bound (docs/STATIC_ANALYSIS.md "Packed planes").  A NEW pack_*/
-# unpack_* kernel pair in kernels.py whose base name is not registered
-# fails the build — packing an unbounded value silently truncates it.
-#   name -> (bits per lane, bound derivation summary)
-PACKED_PLANES: Dict[str, tuple] = {
-    # kernels.pack_bits/unpack_bits lanes: bools, 1 bit by construction.
-    "bits": (1, "bool planes; lossless by construction"),
-    # kernels.pack_u16_pairs/unpack_u16_pairs lanes: loss rates, which
-    # chaos._rate_to_fp validates into [0, LOSS_SCALE] with
-    # LOSS_SCALE == 10_000 < 2**16.
-    "u16_pairs": (16, "loss rates <= LOSS_SCALE (chaos._rate_to_fp)"),
-    # kernels.pack_bits_g/unpack_bits_g lanes: bools packed 32:1 along the
-    # GROUP axis (word w's bit j = group 32*w + j) — the recent_active
-    # scan-carry form (ISSUE 8); 1 bit by construction, zero-padded past
-    # G, exact round-trip vs the simref.host_pack_bits_g numpy twin.
-    "bits_g": (1, "bool planes packed along G; lossless by construction"),
-    # pallas_step's packed chaos-kernel operands (not kernels.py fns; the
-    # builders assert the bounds at construction time):
-    #   roles word = state | leader_id << 2 | heartbeat_elapsed << 6
-    #     state < 4 (the ROLE_* code set), leader_id <= n_peers (asserted
-    #     <= 15 in _build_chaos_round), heartbeat_elapsed <=
-    #     heartbeat_tick (tick_kernel resets at the tick; asserted
-    #     < 2**24 in _build_chaos_round).
-    "roles": (30, "state<4, leader_id<16, hb<=heartbeat_tick<2**24"),
-    #   masks word = voter | member << 1 | crashed << 2 (three bools).
-    "masks": (3, "three bool planes"),
-    # kernels.pack_blackbox_meta/unpack_blackbox_meta lanes (ISSUE 15):
-    # the black-box ring record word — role < 4 (the ROLE_* code set, 2
-    # bits), acting leader id in [0, n_peers] with n_peers <= 8 (the TPU
-    # peer-axis bound; 4 bits), and the N_SAFETY == 9 per-round
-    # fired-slot indicators (1 bit each) = 15 bits
-    # (docs/STATIC_ANALYSIS.md "Black-box planes").
-    "blackbox_meta": (
-        15, "role<4, leader_id<=n_peers<16, N_SAFETY=9 violation bits"
-    ),
-}
-
-# Damping planes (ISSUE 7): device state added by check-quorum/pre-vote,
-# registered here so a dtype/bound change goes through this registry like
-# every other plane.  recent_active is bool[P, P, G] (1 bit, no overflow
-# surface; read-and-cleared at each owner's election-timeout boundary and
-# wholesale at become_leader — the GC007 anchor on SimState.recent_active
-# pins the dtype).  The lease predicate's tick counter operand
-# (election_elapsed) is bounded at LEADERS by election_tick (tick_kernel
-# resets at the boundary) and at followers by randomized_timeout <
-# 2*election_tick at reset sites — both fit 8 bits for election_tick <=
-# 127, which is what would let a future packed-planes pass carry them as
-# u8 lanes; they stay int32 today for the TPU-native [P, G] layout.
-#   SimState field -> (bits needed, bound derivation summary); enforced
-#   by check_sim below: every key must BE a SimState field, and
-#   recent_active's GC007 anchor must stay bool.
-DAMPING_PLANES: Dict[str, tuple] = {
-    "recent_active": (1, "bool; boundary read-and-clear + won reset"),
-    "election_elapsed": (
-        8,
-        "lease operand: < election_tick at leaders (boundary reset); "
-        "< 2*election_tick at followers (timeout redraw bound)",
-    ),
-}
-
-# Transfer planes (ISSUE 12): device state added by the leader-transfer
-# protocol (SimConfig.transfer), registered like the damping planes so a
-# dtype/bound change goes through this registry.  transferee is the
-# per-owner lead_transferee peer id: values are validated into
-# [0, n_peers] by kernels.apply_transfer (the reference's
-# progress-map/learner/self checks) and only ever SET from the
-# `transfer_propose` command or cleared to 0 — never arithmetic, so with
-# n_peers <= 8 (the TPU peer-axis bound) it fits 4 bits and has no
-# overflow surface; it stays int32 for the native [P, G] plane layout.
-# Enforced by check_sim below exactly like DAMPING_PLANES: every key
-# must BE a SimState field.
-TRANSFER_PLANES: Dict[str, tuple] = {
-    "transferee": (
-        4,
-        "peer id in [0, n_peers]; set from validated commands "
-        "(kernels.apply_transfer) or cleared, never arithmetic",
-    ),
-}
-
-# Black-box planes (ISSUE 15): the device flight recorder
-# (sim.BlackboxState), registered like the damping planes so a
-# field/dtype change goes through this registry.  The W-window wrap
-# derivation (docs/STATIC_ANALYSIS.md "Black-box planes"): the three
-# [W, G] ring planes are OVERWRITTEN in place every W rounds
-# (slot = round_idx % W — kernels.blackbox_fold never accumulates into
-# them), so they have no growth surface at all; `trip_round` is a
-# min-fold of absolute round indices, every one < the compiled horizon
-# < 2**31 (the chaos/reconfig/workload compile bounds) or the INF
-# sentinel; `round_idx` grows +1/round, wrap horizon 2**31 rounds —
-# out of model, like the commit plane itself.  Enforced by check_sim:
-# BlackboxState's fields and this registry must agree exactly.
-BLACKBOX_PLANES: Dict[str, str] = {
-    "meta": "ring slot, overwritten every W rounds (no accumulation); "
-            "word bits bounded by PACKED_PLANES `blackbox_meta`",
-    "term": "ring slot of group max term (bounded by the protocol's own "
-            "int32 term plane)",
-    "commit": "ring slot of group max commit (bounded by the int32 "
-              "commit plane)",
-    "trip_round": "min-fold of round indices < compiled horizon < 2**31",
-    "round_idx": "+1/round; wrap horizon 2**31 rounds, out of model",
-}
-
-# Read planes (ISSUE 13): the client-workload runner's int32 accumulators
-# and carry (raft_tpu/multiraft/workload.py), registered like the counter
-# planes so a new slot ships with a derived bound
-# (docs/STATIC_ANALYSIS.md "Read planes").  Every RS_* stat slot and
-# every latency-histogram bucket grows by at most G per round, and
-# workload.compile_plan asserts rounds x G < 2**31 at compile time — the
-# chaos/reconfig no-wrap argument verbatim.  The carry planes are not
-# accumulators: pending_mode holds sim.READ_* codes (<= 2) and
-# pending_since an absolute round index (< n_rounds < 2**31 by the same
-# compile bound).  Enforced by check_workload below: every RS_* constant
-# in workload.py must be registered, N_READ_STATS must equal the registry
-# size, and the compile-time wrap assert must survive.
-READ_PLANES: Dict[str, str] = {
-    "RS_ISSUED": "<= G fresh reads per round",
-    "RS_SERVED_LEASE": "<= G lease serves per round",
-    "RS_SERVED_QUORUM": "<= G quorum serves per round",
-    "RS_DEGRADED_SERVES": "<= G degraded serves per round",
-    "RS_RETRY_ROUNDS": "<= G outstanding (group, round) pairs per round",
-    "RS_DROPPED_FIRES": "<= G dropped fires per round",
-}
+# The GC008 registries, now DERIVED from the PlaneSpec rows in
+# raft_tpu/multiraft/planes.py (one source of truth for plane plumbing;
+# the per-registry derivation commentary lives on the rows themselves and
+# in docs/STATIC_ANALYSIS.md):
+#   COUNTER_PLANES    kernels.CTR_* slots (window-drained accumulators)
+#   HEALTH_PLANES     kernels.HP_* slots -> max additive growth per round
+#   DECLARED_BOUNDED  update_health names with documented (not AST-proven)
+#                     bounds
+#   PACKED_PLANES     packed-word lane families -> (bits, derivation)
+#   DAMPING_PLANES    check-quorum/pre-vote SimState fields -> (bits, why)
+#   TRANSFER_PLANES   leader-transfer SimState fields -> (bits, why)
+#   BLACKBOX_PLANES   BlackboxState fields -> wrap derivation
+#   READ_PLANES       workload.RS_* slots -> per-round growth bound
+BUDGET_PER_GROUP: int = _planes.BUDGET_PER_GROUP
+WRAP_SHIFT: int = _planes.WRAP_SHIFT
+COUNTER_PLANES: Set[str] = _planes.COUNTER_PLANES
+HEALTH_PLANES: Dict[str, int] = _planes.HEALTH_PLANES
+DECLARED_BOUNDED: Dict[str, int] = _planes.DECLARED_BOUNDED
+PACKED_PLANES: Dict[str, tuple] = _planes.PACKED_PLANES
+DAMPING_PLANES: Dict[str, tuple] = _planes.DAMPING_PLANES
+TRANSFER_PLANES: Dict[str, tuple] = _planes.TRANSFER_PLANES
+BLACKBOX_PLANES: Dict[str, str] = _planes.BLACKBOX_PLANES
+READ_PLANES: Dict[str, str] = _planes.READ_PLANES
 
 
 def _v(sf: SourceFile, lineno: int, message: str) -> Violation:

@@ -1,0 +1,553 @@
+"""GC016 registry-closure: the plane registry is the single source of truth.
+
+``raft_tpu/multiraft/planes.py`` declares one PlaneSpec row per device
+plane; five sites consume it (checkpoint field sets, sharding specs, the
+packed scan carry, steady_mask's defuse list, and the GC008 overflow
+registries in this package).  GC016 proves the loop is closed in BOTH
+directions:
+
+  * every owner-site field (SimState / BlackboxState / ReconfigState
+    NamedTuple fields, workload RS_* slots), checkpoint key, sharding
+    entry, and steady-mask defuse condition resolves to a registry row —
+    field lists are checked IN ORDER against the registry so save/load
+    and sharding iteration order is pinned;
+  * every consumer site actually derives from the registry accessors
+    (no hand-written field list can silently bypass it), and
+    ``engine/overflow.py`` has not regrown a local copy of the seven
+    GC008 dicts it now imports;
+  * row metadata is live: gating flags exist as SimConfig fields, GC007
+    ``# gc:`` anchors match the row's dtype+shape, and oracle symbols
+    resolve to real definitions.
+
+Zero-dependency like the rest of the engine: planes.py is stdlib-only and
+is loaded standalone by ``overflow._load_planes`` (shared here as
+``overflow._planes``), never through the jax-importing package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+import importlib.util
+
+from ..core import Context, SourceFile, Violation
+
+GC016 = "GC016"
+GC016_SLUG = "registry-closure"
+
+# Closed vocabularies for PlaneSpec enum-ish fields; a typo'd policy
+# string would silently fall out of every accessor filter.
+_FAMILIES = {
+    "core",
+    "counter",
+    "health",
+    "packed",
+    "damping",
+    "transfer",
+    "blackbox",
+    "read",
+    "read-carry",
+    "reconfig",
+}
+_PACKINGS = {"none", "bits_g", "word"}
+_CHECKPOINTS = {"none", "state", "blackbox", "read", "reconfig"}
+_SHARDINGS = {"none", "minor-G", "replicate"}
+
+# The seven GC008 registries + the three scalar declarations overflow.py
+# must bind FROM the loaded planes module, never from local literals.
+_OVERFLOW_IMPORTED = (
+    "BUDGET_PER_GROUP",
+    "WRAP_SHIFT",
+    "DECLARED_BOUNDED",
+    "COUNTER_PLANES",
+    "HEALTH_PLANES",
+    "PACKED_PLANES",
+    "DAMPING_PLANES",
+    "TRANSFER_PLANES",
+    "BLACKBOX_PLANES",
+    "READ_PLANES",
+)
+
+
+def _v(path: str, line: int, msg: str) -> Violation:
+    return Violation(path, line, GC016, GC016_SLUG, msg)
+
+
+def _module_file(
+    files: Sequence[SourceFile], suffix: str
+) -> Optional[SourceFile]:
+    for sf in files:
+        if sf.norm().endswith(suffix):
+            return sf
+    return None
+
+
+def _class_def(sf: SourceFile, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _ann_fields(cls: ast.ClassDef) -> List[Tuple[str, ast.AnnAssign]]:
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            out.append((stmt.target.id, stmt))
+    return out
+
+
+def _anchor_text(sf: SourceFile, lineno: int) -> str:
+    line = sf.lines[lineno - 1] if 1 <= lineno <= len(sf.lines) else ""
+    if "# gc:" in line:
+        return line.split("# gc:", 1)[1].strip()
+    return ""
+
+
+def _function_def(sf: SourceFile, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _calls_accessor(func: ast.FunctionDef, attr: str) -> bool:
+    """True if `func` (including nested defs) calls planes.<attr>."""
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == attr
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "planes"
+        ):
+            return True
+    return False
+
+
+def _load_registry(sf: SourceFile):
+    """Standalone-exec the SCANNED planes.py (stdlib-only by contract) —
+    the rule must check the tree it is pointed at, so fixture trees carry
+    fixture registries and never see the host repo's."""
+    spec = importlib.util.spec_from_file_location(
+        "_gc016_plane_registry", sf.path
+    )
+    assert spec is not None and spec.loader is not None, sf.path
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def check_registry(
+    files: Sequence[SourceFile], ctx: Context
+) -> Iterator[Violation]:
+    planes_sf = _module_file(files, "raft_tpu/multiraft/planes.py")
+    if planes_sf is None:
+        # No registry in the scanned tree (a fixture about other rules);
+        # the real tree always scans raft_tpu, where a MISSING planes.py
+        # breaks the overflow import before any rule runs.
+        return
+    try:
+        planes = _load_registry(planes_sf)
+    except Exception as e:  # exec failure = broken registry = violation
+        yield _v(
+            planes_sf.display_path, 1,
+            f"planes.py failed to load standalone ({e}) — the registry "
+            "must stay stdlib-only and import-clean",
+        )
+        return
+    yield from _check_rows(planes, planes_sf.display_path)
+    sim_sf = _module_file(files, "raft_tpu/multiraft/sim.py")
+    if sim_sf is not None:
+        yield from _check_sim(planes, sim_sf)
+    ckpt_sf = _module_file(files, "raft_tpu/multiraft/checkpoint.py")
+    if ckpt_sf is not None:
+        yield from _check_checkpoint(planes, ckpt_sf)
+    shard_sf = _module_file(files, "raft_tpu/multiraft/sharding.py")
+    if shard_sf is not None:
+        yield from _check_sharding(shard_sf)
+    pallas_sf = _module_file(files, "raft_tpu/multiraft/pallas_step.py")
+    if pallas_sf is not None:
+        yield from _check_steady(planes, pallas_sf)
+    reconf_sf = _module_file(files, "raft_tpu/multiraft/reconfig.py")
+    if reconf_sf is not None:
+        yield from _check_reconfig(planes, reconf_sf)
+    work_sf = _module_file(files, "raft_tpu/multiraft/workload.py")
+    if work_sf is not None:
+        yield from _check_workload(planes, work_sf)
+    yield from _check_overflow_drift(ctx)
+    yield from _check_oracles(planes, planes_sf.display_path, files, ctx)
+
+
+def _check_rows(planes, path: str) -> Iterator[Violation]:
+    seen: Set[Tuple[str, str]] = set()
+    for r in planes.REGISTRY:
+        key = (r.owner, r.name)
+        if key in seen:
+            yield _v(path, 1, f"duplicate registry row {r.owner}.{r.name}")
+        seen.add(key)
+        if r.family not in _FAMILIES:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} has unknown family {r.family!r} "
+                f"(known: {sorted(_FAMILIES)})",
+            )
+        if r.packing not in _PACKINGS:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} has unknown packing {r.packing!r}",
+            )
+        if r.checkpoint not in _CHECKPOINTS:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} has unknown checkpoint policy "
+                f"{r.checkpoint!r}",
+            )
+        if r.sharding not in _SHARDINGS:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} has unknown sharding {r.sharding!r}",
+            )
+        if r.steady not in ("fusable", "defuse") and not r.steady.startswith(
+            "predicate:"
+        ):
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} has unknown steady policy "
+                f"{r.steady!r}",
+            )
+        if r.steady == "defuse" and not r.flag:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} is steady=defuse but has no gating "
+                "flag — steady_mask can only defuse on a SimConfig flag",
+            )
+
+
+def _check_struct_fields(
+    planes,
+    sf: SourceFile,
+    cls_name: str,
+    expected: Tuple[str, ...],
+    owner: str,
+    check_anchor: bool,
+) -> Iterator[Violation]:
+    cls = _class_def(sf, cls_name)
+    if cls is None:
+        if expected:
+            yield _v(
+                sf.display_path, 1,
+                f"{cls_name} not found but the registry has {owner} rows",
+            )
+        return
+    fields = _ann_fields(cls)
+    names = tuple(n for n, _ in fields)
+    if names != expected:
+        yield _v(
+            sf.display_path, cls.lineno,
+            f"{cls_name} fields {list(names)} != registry {owner} rows "
+            f"{list(expected)} (order included — checkpoint/sharding "
+            "iteration is the registry iteration; update planes.py in "
+            "lockstep with the NamedTuple)",
+        )
+        return
+    if not check_anchor:
+        return
+    for name, stmt in fields:
+        r = planes.row(owner, name)
+        want = f"{r.dtype}{r.shape}"
+        got = _anchor_text(sf, stmt.lineno)
+        if not got.startswith(want):
+            yield _v(
+                sf.display_path, stmt.lineno,
+                f"{cls_name}.{name}'s `# gc:` anchor {got!r} does not match "
+                f"its registry row ({want!r}) — the GC007 anchor and the "
+                "PlaneSpec dtype/shape must agree",
+            )
+
+
+def _check_sim(planes, sf: SourceFile) -> Iterator[Violation]:
+    yield from _check_struct_fields(
+        planes, sf, "SimState", planes.sim_state_fields(), "SimState", True
+    )
+    yield from _check_struct_fields(
+        planes,
+        sf,
+        "BlackboxState",
+        tuple(r.name for r in planes.rows(owner="BlackboxState")),
+        "BlackboxState",
+        True,
+    )
+    # Flag-gated rows <-> Optional[...] = None fields, exactly.
+    cls = _class_def(sf, "SimState")
+    if cls is not None:
+        optional = {
+            n for n, stmt in _ann_fields(cls)
+            if stmt.value is not None
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is None
+        }
+        gated = set(planes.optional_sim_fields())
+        for name in sorted(optional - gated):
+            yield _v(
+                sf.display_path, cls.lineno,
+                f"SimState.{name} defaults to None but its registry row has "
+                "no gating flag — declare the flag(s) in planes.py so the "
+                "checkpoint/sharding know the plane is optional",
+            )
+        for name in sorted(gated - optional):
+            yield _v(
+                sf.display_path, cls.lineno,
+                f"registry row SimState.{name} is flag-gated but the field "
+                "is not Optional (= None) — a gated plane must be absent "
+                "when its flag is off",
+            )
+    # Every gating flag names a real SimConfig field.
+    cfg = _class_def(sf, "SimConfig")
+    cfg_fields = {n for n, _ in _ann_fields(cfg)} if cfg is not None else set()
+    for flag in planes.gating_flags():
+        if flag not in cfg_fields:
+            yield _v(
+                sf.display_path,
+                cfg.lineno if cfg is not None else 1,
+                f"registry gating flag {flag!r} is not a SimConfig field",
+            )
+    # Consumption: the packed scan carry derives from the registry.
+    if "packed_carry_fields" not in sf.text:
+        yield _v(
+            sf.display_path, 1,
+            "sim.py does not call planes.packed_carry_fields() — the scan-"
+            "carry packing must derive from the registry's packing column",
+        )
+
+
+# Hand-written field collections that re-enumerate a whole gated/persisted
+# family are exactly the duplication the registry exists to delete: flag a
+# literal list/tuple/set/dict-keys whose strings cover one of these sets.
+def _forbidden_families(planes) -> List[Tuple[str, Set[str]]]:
+    out: List[Tuple[str, Set[str]]] = [
+        ("optional SimState fields", set(planes.optional_sim_fields())),
+    ]
+    for policy in ("blackbox", "read", "reconfig"):
+        out.append(
+            (
+                f"checkpoint family {policy!r}",
+                set(planes.checkpoint_fields(policy)),
+            )
+        )
+    return out
+
+
+def _literal_strings(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        vals = set()
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.add(e.value)
+        return vals
+    return None
+
+
+def _check_checkpoint(planes, sf: SourceFile) -> Iterator[Violation]:
+    for accessor in ("checkpoint_fields", "optional_sim_fields"):
+        if accessor not in sf.text:
+            yield _v(
+                sf.display_path, 1,
+                f"checkpoint.py does not call planes.{accessor}() — save/"
+                "load field sets must derive from the registry",
+            )
+    for node in ast.walk(sf.ast_tree):
+        vals = _literal_strings(node)
+        if not vals:
+            continue
+        for label, family in _forbidden_families(planes):
+            if family and family <= vals:
+                yield _v(
+                    sf.display_path, node.lineno,
+                    f"literal field collection re-enumerates the {label} "
+                    "(the registry's job) — iterate the planes.py accessor "
+                    "instead",
+                )
+
+
+def _check_sharding(sf: SourceFile) -> Iterator[Violation]:
+    for fname in ("state_sharding", "blackbox_sharding"):
+        func = _function_def(sf, fname)
+        if func is None:
+            yield _v(sf.display_path, 1, f"sharding.{fname}() not found")
+            continue
+        if not _calls_accessor(func, "rows"):
+            yield _v(
+                sf.display_path, func.lineno,
+                f"sharding.{fname}() does not iterate planes.rows(...) — "
+                "PartitionSpecs must derive from the registry's shape/"
+                "sharding columns",
+            )
+
+
+def _check_steady(planes, sf: SourceFile) -> Iterator[Violation]:
+    func = _function_def(sf, "steady_mask")
+    if func is None:
+        yield _v(sf.display_path, 1, "pallas_step.steady_mask() not found")
+        return
+    if not _calls_accessor(func, "steady_defuse_flags"):
+        yield _v(
+            sf.display_path, func.lineno,
+            "steady_mask() does not consult planes.steady_defuse_flags() — "
+            "wholesale fused-horizon rejection must derive from the "
+            "registry's steady column",
+        )
+    defuse = set(planes.steady_defuse_flags())
+    for node in ast.walk(func):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "cfg"
+            and node.attr in defuse
+        ):
+            yield _v(
+                sf.display_path, node.lineno,
+                f"steady_mask() branches on cfg.{node.attr} directly; that "
+                "flag is registry-declared steady=defuse — go through "
+                "planes.steady_defuse_flags() so a future defuse plane "
+                "cannot be forgotten here",
+            )
+
+
+def _check_reconfig(planes, sf: SourceFile) -> Iterator[Violation]:
+    yield from _check_struct_fields(
+        planes,
+        sf,
+        "ReconfigState",
+        tuple(r.name for r in planes.rows(owner="ReconfigState")),
+        "ReconfigState",
+        False,
+    )
+
+
+def _check_workload(planes, sf: SourceFile) -> Iterator[Violation]:
+    module_names = set()
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    module_names.add(t.id)
+    for r in planes.rows(owner="workload", family="read"):
+        if r.name not in module_names:
+            yield _v(
+                sf.display_path, 1,
+                f"registry read slot {r.name!r} is not a workload.py "
+                "module-level constant — the row is orphaned",
+            )
+    carry = _class_def(sf, "ReadCarry")
+    if carry is not None:
+        carry_fields = tuple(n for n, _ in _ann_fields(carry))
+        read_fields = planes.checkpoint_fields("read")
+        if read_fields[: len(carry_fields)] != carry_fields:
+            yield _v(
+                sf.display_path, carry.lineno,
+                f"ReadCarry fields {list(carry_fields)} are not the leading "
+                f"read-checkpoint registry rows {list(read_fields)} — "
+                "checkpoint.save_read_state's order is the registry order",
+            )
+
+
+def _check_overflow_drift(ctx: Context) -> Iterator[Violation]:
+    """overflow.py (outside the scanned set — tools/) must keep importing
+    the GC008 registries from planes.py, never regrow local literals."""
+    path = ctx.repo_root / "tools" / "graftcheck" / "engine" / "overflow.py"
+    if not path.is_file():
+        return  # fixture repo_root: no linter checkout to audit
+    display = "tools/graftcheck/engine/overflow.py"
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=display)
+    except (OSError, SyntaxError):
+        yield _v(display, 1, "overflow.py unreadable for registry-drift check")
+        return
+    bound: Dict[str, Tuple[int, bool]] = {}
+    for node in ast.iter_child_nodes(tree):
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in _OVERFLOW_IMPORTED:
+                from_planes = (
+                    isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "_planes"
+                )
+                bound[t.id] = (node.lineno, from_planes)
+    for name in _OVERFLOW_IMPORTED:
+        if name not in bound:
+            yield _v(
+                display, 1,
+                f"overflow.py no longer binds {name} — the GC008 registry "
+                "must be imported from planes.py",
+            )
+        elif not bound[name][1]:
+            yield _v(
+                display, bound[name][0],
+                f"overflow.py binds {name} from a local literal instead of "
+                f"_planes.{name} — the plane registry (planes.py) is the "
+                "single source of truth; local copies drift",
+            )
+
+
+def _check_oracles(
+    planes, path: str, files: Sequence[SourceFile], ctx: Context
+) -> Iterator[Violation]:
+    cache: Dict[str, Optional[Set[str]]] = {}
+
+    def top_level(mod: str) -> Optional[Set[str]]:
+        if mod in cache:
+            return cache[mod]
+        suffix = f"raft_tpu/multiraft/{mod}.py"
+        sf = _module_file(files, suffix)
+        tree: Optional[ast.AST] = sf.ast_tree if sf is not None else None
+        if tree is None:
+            try:
+                tree = ast.parse(
+                    (ctx.repo_root / suffix).read_text(encoding="utf-8")
+                )
+            except (OSError, SyntaxError):
+                cache[mod] = None
+                return None
+        names = {
+            n.name
+            for n in ast.iter_child_nodes(tree)
+            if isinstance(n, (ast.FunctionDef, ast.ClassDef))
+        }
+        cache[mod] = names
+        return names
+
+    for r in planes.REGISTRY:
+        if r.oracle is None:
+            continue
+        mod, _, sym = r.oracle.partition(".")
+        if not sym:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} oracle {r.oracle!r} is not of the "
+                "form 'module.Symbol'",
+            )
+            continue
+        names = top_level(mod)
+        if names is None:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} oracle module "
+                f"raft_tpu/multiraft/{mod}.py is unreadable",
+            )
+        elif sym not in names:
+            yield _v(
+                path, 1,
+                f"row {r.owner}.{r.name} oracle {r.oracle!r} does not "
+                f"resolve: no top-level def/class {sym} in "
+                f"raft_tpu/multiraft/{mod}.py",
+            )

@@ -70,12 +70,40 @@ class ParityObligationsRule(Rule):
         return False
 
 
+class RegistryClosureRule(Rule):
+    id = "GC016"
+    slug = "registry-closure"
+    doc = (
+        "every plane field/checkpoint key/sharding spec/defuse flag "
+        "resolves to a planes.py registry row, and every row is consumed "
+        "(--engine)"
+    )
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
+class StaleMarkerRule(Rule):
+    id = "GC017"
+    slug = "stale-marker"
+    doc = (
+        "allow markers that suppress nothing and `# gc:` anchors the "
+        "engine never consults are violations; --fix-markers removes them "
+        "(--engine)"
+    )
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
 def engine_rules() -> List[Rule]:
     return [
         ShapeDtypeRule(),
         PlaneOverflowRule(),
         TracedEscapeRule(),
         ParityObligationsRule(),
+        RegistryClosureRule(),
+        StaleMarkerRule(),
     ]
 
 

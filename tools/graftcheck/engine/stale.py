@@ -1,0 +1,263 @@
+"""GC017 stale-marker audit: suppressions must keep earning their place.
+
+An ``# graftcheck: allow-<rule>`` marker that no longer suppresses a
+violation is live ammunition pointed at future code: it documents a
+justification for a problem that no longer exists, and when the line
+later regresses the stale marker swallows the NEW violation silently.
+The v1-v3 marker population was never garbage-collected, so GC017 makes
+staleness itself a violation:
+
+  * a justified, known-rule allow marker whose covered line produces no
+    RAW (pre-suppression) violation of that rule is stale.  Trace-rule
+    markers (GC011-GC015) are exempt — the engine run cannot re-derive
+    graph-inventory findings without jax, so their liveness is only
+    checkable under ``--trace``;
+  * a ``# gc:`` shape anchor in an ENGINE module (interp.ENGINE_MODULES)
+    sitting on a line the abstract interpreter never consults — not a
+    registered-struct AnnAssign, not a function parameter, not an Assign
+    statement in an interpreted body — is dead weight: it reads like a
+    machine-checked claim but nothing checks it.  Anchors in non-engine
+    modules (chaos/reconfig/workload) stay exempt: they are declarative
+    documentation by convention, consumed by humans and GC016, not the
+    interpreter.
+
+``--fix-markers`` removes everything GC017 flags: standalone marker
+lines are deleted, inline markers/anchors are stripped back to the code.
+Markers inside string literals (rule fixtures in tests, doc examples)
+are never considered: only real COMMENT tokens count.
+"""
+
+from __future__ import annotations
+
+import io
+import tokenize
+from typing import Dict, Iterator, List, NamedTuple, Sequence, Set, Tuple
+
+import ast
+
+from ..core import (
+    AllowMarker,
+    Context,
+    Rule,
+    SourceFile,
+    Violation,
+    _MARKER_RE,
+    find_markers,
+)
+from .interp import ENGINE_MODULES, _ANCHOR_RE
+
+GC017 = "GC017"
+GC017_SLUG = "stale-marker"
+
+# Rules whose raw violations the engine run cannot reproduce: trace rules
+# need jax (--trace), GC000 is the marker meta-rule, GC017 is us.
+_EXEMPT_RULE_IDS = {"GC000", "GC011", "GC012", "GC013", "GC014", "GC015", GC017}
+
+
+class StaleItem(NamedTuple):
+    path: str
+    line: int  # 1-based line the marker/anchor is written on
+    kind: str  # "marker" | "anchor"
+    detail: str  # rule name or anchor spec, for messages
+    standalone: bool  # whole line is the comment (delete vs strip)
+
+
+def _comment_lines(sf: SourceFile) -> Set[int]:
+    """1-based lines carrying a real COMMENT token (not string content)."""
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(sf.text).readline):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except tokenize.TokenError:
+        pass  # unterminated something: the per-file run reports it
+    return out
+
+
+def _covered_line(sf: SourceFile, m: AllowMarker) -> int:
+    """Mirror of core.apply_markers' covered_line: a standalone marker
+    covers the next non-blank, non-comment source line."""
+    if not m.standalone:
+        return m.line
+    i = m.line
+    while i < len(sf.lines):
+        stripped = sf.lines[i].strip()
+        if stripped and not stripped.startswith("#"):
+            return i + 1
+        i += 1
+    return m.line
+
+
+def _consulted_anchor_lines(sf: SourceFile) -> Set[int]:
+    """Lines where interp.py actually reads ``# gc:`` anchors: registered
+    NamedTuple AnnAssign fields, function parameters (module-level and
+    nested), and Assign statements inside interpreted bodies."""
+    lines: Set[int] = set()
+
+    def visit_function(func: ast.FunctionDef) -> None:
+        for arg in func.args.args + func.args.kwonlyargs:
+            lines.add(arg.lineno)
+        # walk_local semantics: descend into compound statements but not
+        # nested defs/classes; interp recurses into nested defs itself.
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.FunctionDef):
+                visit_function(node)
+                continue
+            if isinstance(node, (ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Assign):
+                lines.add(node.lineno)
+            stack.extend(ast.iter_child_nodes(node))
+
+    for node in ast.iter_child_nodes(sf.ast_tree):
+        if isinstance(node, ast.FunctionDef):
+            visit_function(node)
+        elif isinstance(node, ast.ClassDef) and any(
+            (isinstance(b, ast.Name) and b.id == "NamedTuple")
+            or (isinstance(b, ast.Attribute) and b.attr == "NamedTuple")
+            for b in node.bases
+        ):
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    lines.add(stmt.lineno)
+    return lines
+
+
+def find_stale(
+    files: Sequence[SourceFile],
+    ctx: Context,
+    engine_raw: Sequence[Violation],
+    rules: Sequence[Rule],
+) -> List[StaleItem]:
+    """Every stale marker/anchor in `files`.  `engine_raw` is the engine
+    layer's pre-suppression violation list (GC007-GC010 + GC016); the
+    per-file rules are re-run raw here, so a marker is stale exactly when
+    NOTHING it could suppress exists."""
+    by_slug: Dict[str, Rule] = {r.slug.lower(): r for r in rules}
+    by_id: Dict[str, Rule] = {r.id.lower(): r for r in rules}
+    engine_suffixes = {suffix for _, suffix in ENGINE_MODULES}
+
+    raw_at: Dict[Tuple[str, str, int], bool] = {}
+    for v in engine_raw:
+        raw_at[(v.path, v.rule_id, v.line)] = True
+
+    out: List[StaleItem] = []
+    for sf in files:
+        if not sf.is_python:
+            continue
+        comments = _comment_lines(sf)
+        markers = [m for m in find_markers(sf) if m.line in comments]
+        if markers:
+            # Per-file raw violations for this file (no marker filtering).
+            for rule in rules:
+                if rule.applies(sf):
+                    for v in rule.check(sf, ctx):
+                        raw_at[(v.path, v.rule_id, v.line)] = True
+        for m in markers:
+            rule = by_slug.get(m.rule.lower()) or by_id.get(m.rule.lower())
+            if rule is None or not m.justified:
+                continue  # GC000's problem, not staleness
+            if rule.id in _EXEMPT_RULE_IDS:
+                continue
+            lines = {m.line, _covered_line(sf, m)}
+            if not any(
+                (sf.display_path, rule.id, ln) in raw_at for ln in lines
+            ):
+                out.append(
+                    StaleItem(
+                        sf.display_path, m.line, "marker",
+                        f"allow-{m.rule}", m.standalone,
+                    )
+                )
+        if any(sf.norm().endswith(sfx) for sfx in engine_suffixes):
+            consulted = _consulted_anchor_lines(sf)
+            for i, line in enumerate(sf.lines, start=1):
+                if i not in comments:
+                    continue
+                am = _ANCHOR_RE.search(line)
+                if am is None:
+                    continue
+                if i not in consulted:
+                    out.append(
+                        StaleItem(
+                            sf.display_path, i, "anchor",
+                            am.group("spec").strip(),
+                            line.strip().startswith("#"),
+                        )
+                    )
+    out.sort(key=lambda s: (s.path, s.line))
+    return out
+
+
+def stale_violations(items: Sequence[StaleItem]) -> Iterator[Violation]:
+    for s in items:
+        if s.kind == "marker":
+            msg = (
+                f"stale `# graftcheck: {s.detail}` marker: no violation of "
+                "that rule exists on its covered line — it would silently "
+                "swallow a FUTURE regression; remove it (--fix-markers)"
+            )
+        else:
+            msg = (
+                f"stale `# gc: {s.detail}` anchor: the engine interpreter "
+                "never consults this line (not a struct field, parameter, "
+                "or interpreted assignment) — the claim is unchecked; "
+                "remove it or move it to a consulted line (--fix-markers)"
+            )
+        yield Violation(s.path, s.line, GC017, GC017_SLUG, msg)
+
+
+def fix_files(items: Sequence[StaleItem]) -> Dict[str, int]:
+    """Apply --fix-markers: delete standalone stale comment lines, strip
+    inline stale comments back to the code.  Returns {path: fixes}."""
+    by_path: Dict[str, List[StaleItem]] = {}
+    for s in items:
+        by_path.setdefault(s.path, []).append(s)
+    fixed: Dict[str, int] = {}
+    for path, group in by_path.items():
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        trailing_nl = text.endswith("\n")
+        lines = text.split("\n")
+        if trailing_nl:
+            lines = lines[:-1]
+        drop: Set[int] = set()
+        for s in group:
+            idx = s.line - 1
+            if not (0 <= idx < len(lines)):
+                continue
+            line = lines[idx]
+            regex = _MARKER_RE if s.kind == "marker" else _ANCHOR_RE
+            m = regex.search(line)
+            # Both regexes match from the comment's own '#': cut there.
+            stripped = line[: m.start()].rstrip() if m is not None else line
+            if stripped.strip():
+                lines[idx] = stripped
+            else:
+                drop.add(idx)
+                if s.kind == "marker" and s.standalone:
+                    # A standalone marker's justification may wrap over
+                    # the following comment-only lines (exactly the block
+                    # core.apply_markers' covered_line skips); they ARE
+                    # the suppression text, so they go with it.
+                    j = idx + 1
+                    while (
+                        j < len(lines)
+                        and lines[j].strip().startswith("#")
+                        # ...but never swallow a DIFFERENT marker/anchor
+                        # stacked below (it suppresses independently).
+                        and not _MARKER_RE.search(lines[j])
+                        and not _ANCHOR_RE.search(lines[j])
+                    ):
+                        drop.add(j)
+                        j += 1
+            fixed[path] = fixed.get(path, 0) + 1
+        new = [ln for i, ln in enumerate(lines) if i not in drop]
+        out = "\n".join(new) + ("\n" if trailing_nl else "")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(out)
+    return fixed
