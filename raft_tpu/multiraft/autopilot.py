@@ -38,6 +38,12 @@ summaries only).  `tools/autopilot_report.py` exploits this for the
 before/after CI gate: the autopilot-on corpus replay must beat the
 autopilot-off replay on MTTR and commit-stall with zero safety
 violations.
+
+Since the runner-registry refactor the cadence segment is BUILT by the
+unified factory (raft_tpu/multiraft/runner.py) from the schedules.py
+registry — :func:`make_cadence_runner` here is a thin behavior-neutral
+wrapper, and the flat schedule-arg tuple comes from
+``runner.schedule_args`` (GC018 machine-checks both).
 """
 
 from __future__ import annotations
@@ -57,8 +63,6 @@ from .reconfig import (
     CompiledReconfig,
     ReconfigPhase,
     ReconfigPlan,
-    _rebuild_scheds,
-    _runner_body,
     compile_plan,
     init_reconfig_state,
 )
@@ -175,158 +179,17 @@ def make_cadence_runner(
     Returns the advanced carry (with a trailing fused-group-rounds int32
     scalar accumulated into cs_rounds' sibling position when `fused` —
     callers get it via the returned tuple's last element).
+
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxpr (GC014 pins it).
     """
-    if not cfg.collect_health:
-        raise ValueError("the autopilot needs SimConfig(collect_health=True)")
-    if not cfg.transfer:
-        raise ValueError(
-            "the autopilot needs SimConfig(transfer=True) — the transfer "
-            "actuation rides the lead_transferee plane"
-        )
-    if fused:
-        from . import pallas_step
-        from .reconfig import pending_in_horizon
+    from . import runner as runner_mod
 
-        fused_fn = pallas_step.steady_round(
-            cfg, rounds=rounds, with_health=True,
-            with_chaos=chaos_compiled is not None, interpret=interpret,
-        )
-
-    with_bb = cfg.blackbox
-
-    def run(st, hl, rst, stats, rstats, safety, *rest):
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
-            bb, csr, r0, transfer, kick, *sched_args = rest
-        else:
-            csr, r0, transfer, kick, *sched_args = rest
-            bb = None
-        sched, chaos_sched = _rebuild_scheds(
-            compiled, chaos_compiled, sched_args
-        )
-        body = _runner_body(
-            cfg, sched, chaos_sched, actions=(r0, transfer, kick)
-        )
-
-        def body2(carry, r):
-            inner, csr = carry[:-1], carry[-1]
-            inner, _ = body(inner, r)
-            hl2 = inner[1]
-            csr = csr + jnp.sum(
-                hl2.planes[kernels.HP_SINCE_COMMIT]
-                >= jnp.int32(cfg.commit_stall_ticks),
-                dtype=jnp.int32,
-            )
-            return inner + (csr,), ()
-
-        def general(args):
-            carry, _ = jax.lax.scan(
-                body2, args, r0 + jnp.arange(rounds, dtype=jnp.int32)
-            )
-            return carry
-
-        # _runner_body carries the optional BlackboxState LAST in its
-        # inner tuple, so the cadence carry is (..., safety[, bb], csr).
-        inner0 = (st, hl, rst, stats, rstats, safety)
-        if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
-            inner0 = inner0 + (bb,)
-
-        if not fused:  # graftcheck: allow-no-python-branch-on-traced — static builder flag
-            return general(inner0 + (csr,)) + (jnp.int32(0),)
-
-        if chaos_compiled is not None:
-            link, loss, crashed, capp = chaos_mod.schedule_planes(
-                chaos_sched, r0
-            )
-        else:
-            link = loss = None
-            crashed = jnp.zeros((cfg.n_peers, cfg.n_groups), bool)
-            capp = 0
-        append = sched.append[sched.phase_of_round[r0]] + capp
-        pend = pending_in_horizon(sched, rst, r0, rounds)
-        mask = pallas_step.steady_mask(
-            cfg, st, crashed, horizon=rounds, link=link,
-            reconfig_pending=pend, loss_rate=loss,
-        )
-        no_action = (~jnp.any(transfer > 0)) & (~jnp.any(kick))
-        # The fused kernel gathers the round-r0 masks once for the whole
-        # block, so no schedule phase may change inside it (phases are
-        # contiguous: endpoint equality is the whole check).
-        last = r0 + jnp.int32(rounds - 1)
-        same_phase = (
-            sched.phase_of_round[r0] == sched.phase_of_round[last]
-        )
-        if chaos_compiled is not None:
-            same_phase = same_phase & (
-                chaos_sched.phase_of_round[r0]
-                == chaos_sched.phase_of_round[last]
-            )
-        # The zero-commit-stall claim needs PROVABLE commit progress, not
-        # just steadiness: steady_mask admits a crashed-majority horizon
-        # (one alive leader, quiet timers) and lossy horizons, where
-        # commits genuinely stall and the general scan would count
-        # stall group-rounds.  Require an alive voter quorum in BOTH
-        # halves and a loss-free horizon — then append > 0 commits every
-        # round and the fold is exactly zero.
-        alive_b = ~crashed
-
-        def _half_quorum(mask):
-            n = jnp.sum(mask, axis=0, dtype=jnp.int32)
-            got = jnp.sum(alive_b & mask, axis=0, dtype=jnp.int32)
-            return (got >= kernels.majority_of(n)) | (n == 0)
-
-        progress_ok = jnp.all(
-            _half_quorum(st.voter_mask) & _half_quorum(st.outgoing_mask)
-        )
-        if loss is not None:
-            progress_ok = progress_ok & jnp.all(loss == 0)
-        pred = (
-            jnp.all(mask) & no_action & same_phase & progress_ok
-            & jnp.all(append > 0)
-        )
-
-        def fast(args):
-            if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
-                st, hl, rst, stats, rstats, safety, bb, csr = args
-            else:
-                st, hl, rst, stats, rstats, safety, csr = args
-                bb = None
-            prev_ll = hl.planes[kernels.HP_LEADERLESS]
-            fargs = (st, crashed, append)
-            if chaos_compiled is not None:
-                fargs = fargs + (loss, r0)
-            st2, hl2 = fused_fn(*fargs, hl)
-            stats2 = chaos_mod.update_chaos_stats(
-                stats, prev_ll, hl2.planes[kernels.HP_LEADERLESS]
-            )
-            # No op, no action, commits flow every round (append > 0 on a
-            # steady horizon): the op carry only refreshes its transition
-            # anchors and the commit-stall fold is exactly zero.
-            rst2 = rst._replace(
-                prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
-            )
-            out = (st2, hl2, rst2, stats2, rstats, safety)
-            if with_bb:  # graftcheck: allow-no-python-branch-on-traced — static config flag
-                # Unreachable with the black box on (steady_mask rejects
-                # blackbox horizons, so pred is constant-false) but the
-                # cond still traces both branches: pass the recorder
-                # through untouched.
-                out = out + (bb,)
-            return out + (csr,)
-
-        carry = jax.lax.cond(
-            pred, fast, general, inner0 + (csr,),
-        )
-        fused_rounds = jnp.where(
-            pred, jnp.int32(rounds * cfg.n_groups), jnp.int32(0)
-        )
-        return carry + (fused_rounds,)
-
-    return jax.jit(
-        run,
-        donate_argnums=(
-            (0, 1, 2, 3, 4, 5, 6, 7) if cfg.blackbox else
-            (0, 1, 2, 3, 4, 5, 6)
-        ),
+    return runner_mod.make_runner(
+        cfg, (compiled, chaos_compiled), cadence=rounds, fused=fused,
+        interpret=interpret,
     )
 
 
@@ -727,15 +590,12 @@ class Autopilot:
         while done < R:
             seg = min(self.cfg.cadence, R - done)
             runner = self._runner_for(compiled, chaos_compiled, seg)
-            sched_args = (
-                compiled.phase_of_round, compiled.append,
-                compiled.op_start, compiled.n_ops, compiled.tgt_voter,
-                compiled.tgt_outgoing, compiled.tgt_learner,
-                compiled.added, compiled.removed,
-                chaos_compiled.phase_of_round,
-                chaos_compiled.link_packed, chaos_compiled.loss_packed,
-                chaos_compiled.crashed_packed, chaos_compiled.append,
-            )
+            # The flat runtime-arg tuple comes from the registry
+            # (schedules.py via runner.schedule_args) — never hand-listed
+            # (GC018).
+            from . import runner as runner_mod
+
+            sched_args = runner_mod.schedule_args(compiled, chaos_compiled)
             out = runner(
                 st, hl, rst, stats, rstats, safety,
                 *((bb,) if bb is not None else ()),

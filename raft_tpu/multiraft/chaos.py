@@ -36,6 +36,11 @@ schedule through real Raft state machines and the harness Network's
 per-edge drops — :func:`host_masks` / :func:`host_loss_draw` are the numpy
 mirrors of the device schedule and must stay bit-identical
 (tests/test_chaos_parity.py).
+
+Since the runner-registry refactor the compiled runner is BUILT by the
+unified factory (raft_tpu/multiraft/runner.py) from the schedules.py
+registry row set; :func:`make_runner` here is a thin behavior-neutral
+wrapper (GC018 machine-checks the closure, GC014 pins the jaxpr).
 """
 
 from __future__ import annotations
@@ -444,92 +449,15 @@ def make_runner(cfg: sim_mod.SimConfig, compiled: CompiledChaos):
     afresh.  The underlying jit and its trailing schedule arguments are
     exposed as ``runner.jitted`` / ``runner.schedule_args`` for the
     graftcheck trace audit (tools/graftcheck/trace/inventory.py).
+
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxpr (GC014 pins it).
     """
-    n_rounds = compiled.n_rounds
-    with_bb = cfg.blackbox
+    from . import runner as runner_mod
 
-    def body(carry, r, sched):
-        if with_bb:
-            st, hl, bb, stats, safety = carry
-        else:
-            st, hl, stats, safety = carry
-            bb = None
-        link, crashed, append = schedule_masks(sched, r)
-        prev_leaderless = hl.planes[kernels.HP_LEADERLESS]
-        st2, hl2 = sim_mod.step(
-            cfg, st, crashed, append, health=hl, link=link
-        )
-        if with_bb:
-            viol = kernels.check_safety_groups(
-                st2.state, st2.term, st2.commit, st2.last_index,
-                st2.agree, st.commit,
-            )
-            # dtype= keeps the slot sums int32 under x64 (GC007); the
-            # per-group sums equal check_safety's counts exactly
-            # (tests/test_forensics.py pins it).
-            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
-            bb = sim_mod.BlackboxState(*kernels.blackbox_fold(
-                bb.meta, bb.term, bb.commit, bb.trip_round, bb.round_idx,
-                st2.state, st2.term, st2.commit, crashed, viol,
-            ))
-        else:
-            safety = safety + kernels.check_safety(
-                st2.state, st2.term, st2.commit, st2.last_index, st2.agree,
-                st.commit,
-            )
-        stats = update_chaos_stats(
-            stats, prev_leaderless, hl2.planes[kernels.HP_LEADERLESS]
-        )
-        out = (
-            (st2, hl2, bb, stats, safety)
-            if with_bb
-            else (st2, hl2, stats, safety)
-        )
-        return out, ()
-
-    def run(st, hl, *args):
-        if with_bb:
-            bb, args = args[0], args[1:]
-        (phase_of_round, link_packed, loss_packed, crashed_packed,
-         append) = args
-        sched = compiled._replace(
-            phase_of_round=phase_of_round,
-            link_packed=link_packed,
-            loss_packed=loss_packed,
-            crashed_packed=crashed_packed,
-            append=append,
-        )
-        stats = jnp.zeros((N_CHAOS_STATS,), jnp.int32)
-        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
-        carry = (
-            (st, hl, bb, stats, safety)
-            if with_bb
-            else (st, hl, stats, safety)
-        )
-        carry, _ = jax.lax.scan(
-            lambda c, r: body(c, r, sched),
-            carry,
-            jnp.arange(n_rounds, dtype=jnp.int32),
-        )
-        return carry
-
-    jitted = jax.jit(
-        run, donate_argnums=(0, 1, 2) if with_bb else (0, 1)
-    )
-    schedule_args = (
-        compiled.phase_of_round,
-        compiled.link_packed,
-        compiled.loss_packed,
-        compiled.crashed_packed,
-        compiled.append,
-    )
-
-    def runner(st, hl, *bb):
-        return jitted(st, hl, *bb, *schedule_args)
-
-    runner.jitted = jitted  # type: ignore[attr-defined]
-    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
-    return runner
+    return runner_mod.make_runner(cfg, (compiled,))
 
 
 def run_plan(

@@ -76,13 +76,20 @@ per-round), and the stats accumulators count at most one event per
 (group, round): ``compile_plan`` asserts rounds x groups < 2**31 so the
 int32 accumulators provably cannot wrap (the GC008 discipline,
 docs/STATIC_ANALYSIS.md).
+
+Since the runner-registry refactor the compiled runners are BUILT by the
+unified factory (raft_tpu/multiraft/runner.py) from the schedules.py
+registry; :func:`make_runner` / :func:`make_split_runner` here are thin
+behavior-neutral wrappers, while ``_runner_body`` — the one shared
+per-round scan body every runner variant closes over — STAYS in this
+module (GC018 machine-checks the closure, GC014 pins the jaxprs).
 """
 
 from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -784,28 +791,6 @@ def _validate_plans(
         )
 
 
-def _rebuild_scheds(compiled, chaos_compiled, sched_args):
-    """Rebind the runtime schedule arguments onto the compiled templates
-    (GC012: schedule arrays enter every runner jit as arguments, never as
-    closure consts) — shared by make_runner and make_split_runner."""
-    sched = compiled._replace(
-        phase_of_round=sched_args[0], append=sched_args[1],
-        op_start=sched_args[2], n_ops=sched_args[3],
-        tgt_voter=sched_args[4], tgt_outgoing=sched_args[5],
-        tgt_learner=sched_args[6], added=sched_args[7],
-        removed=sched_args[8],
-    )
-    if chaos_compiled is not None:
-        chaos_sched = chaos_compiled._replace(
-            phase_of_round=sched_args[9], link_packed=sched_args[10],
-            loss_packed=sched_args[11], crashed_packed=sched_args[12],
-            append=sched_args[13],
-        )
-    else:
-        chaos_sched = None
-    return sched, chaos_sched
-
-
 def _runner_body(
     cfg: sim_mod.SimConfig,
     sched: CompiledReconfig,
@@ -1103,94 +1088,15 @@ def make_runner(
     stats[N_CHAOS_STATS], rstats[N_RECONFIG_STATS], safety[N_SAFETY]);
     state/health/rstate are donated.  ``runner.jitted`` /
     ``runner.schedule_args`` are exposed for the graftcheck trace audit.
+
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxpr (GC014 pins it).
     """
-    n_rounds = compiled.n_rounds
-    _validate_plans(cfg, compiled, chaos_compiled)
+    from . import runner as runner_mod
 
-    with_bb = cfg.blackbox
-
-    def body(carry, r, sched, chaos_sched):
-        return _runner_body(cfg, sched, chaos_sched)(carry, r)
-
-    def run(st, hl, rst, *args):
-        if with_bb:
-            bb, sched_args = args[0], args[1:]
-        else:
-            sched_args = args
-        sched, chaos_sched = _rebuild_scheds(
-            compiled, chaos_compiled, sched_args
-        )
-        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
-        rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
-        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
-        carry = (st, hl, rst, stats, rstats, safety)
-        if with_bb:
-            carry = carry + (bb,)
-        carry, _ = jax.lax.scan(
-            lambda c, r: body(c, r, sched, chaos_sched),
-            carry,
-            jnp.arange(n_rounds, dtype=jnp.int32),
-        )
-        if with_bb:
-            carry, bb = carry[:-1], carry[-1]
-        stf, hlf, rstf, stats, rstats, safety = carry
-        # Tail audit: the scan body checks each apply's mask transition
-        # one round later, so a final-round apply needs this one extra
-        # fold (prev_commit = final commit keeps the commit checks inert
-        # — only the transition + election-safety slots can fire).
-        if with_bb:
-            viol = kernels.check_safety_groups(
-                stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-                stf.commit,
-                voter_mask=stf.voter_mask,
-                outgoing_mask=stf.outgoing_mask,
-                matched=stf.matched,
-                prev_voter_mask=rstf.prev_voter,
-                prev_outgoing_mask=rstf.prev_outgoing,
-            )
-            # dtype= keeps the slot sums int32 under x64 (GC007).
-            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
-            # The tail transition belongs to the LAST real round:
-            # blackbox_mark stamps slot round_idx - 1.
-            meta, trip = kernels.blackbox_mark(
-                bb.meta, bb.trip_round, bb.round_idx, viol
-            )
-            bb = bb._replace(meta=meta, trip_round=trip)
-            return stf, hlf, rstf, stats, rstats, safety, bb
-        safety = safety + kernels.check_safety(
-            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-            stf.commit,
-            voter_mask=stf.voter_mask,
-            outgoing_mask=stf.outgoing_mask,
-            matched=stf.matched,
-            prev_voter_mask=rstf.prev_voter,
-            prev_outgoing_mask=rstf.prev_outgoing,
-        )
-        return stf, hlf, rstf, stats, rstats, safety
-
-    jitted = jax.jit(
-        run, donate_argnums=(0, 1, 2, 3) if with_bb else (0, 1, 2)
-    )
-    schedule_args = (
-        compiled.phase_of_round, compiled.append, compiled.op_start,
-        compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
-        compiled.tgt_learner, compiled.added, compiled.removed,
-    ) + (
-        (
-            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
-            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
-            chaos_compiled.append,
-        )
-        if chaos_compiled is not None
-        else ()
-    )
-
-    def runner(st, hl, rst, *bb):
-        return jitted(st, hl, rst, *bb, *schedule_args)
-
-    runner.jitted = jitted  # type: ignore[attr-defined]
-    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
-    return runner
+    return runner_mod.make_runner(cfg, (compiled, chaos_compiled))
 
 
 def make_split_runner(
@@ -1247,204 +1153,18 @@ def make_split_runner(
     st/hl/rst (and counters) are donated.  ``runner.segments``,
     ``runner.fused_jit``, ``runner.general_jits`` and
     ``runner.schedule_args`` are exposed for tests and the graftcheck
-    trace audit."""
-    from . import pallas_step  # deferred: keeps reconfig importable sans pallas
+    trace audit.
 
-    n_rounds = compiled.n_rounds
-    P, G = cfg.n_peers, cfg.n_groups
-    if not cfg.collect_health:
-        raise ValueError(
-            "make_split_runner needs SimConfig(collect_health=True) — the "
-            "MTTR stats and the fused block's closed-form fold ride on the "
-            "health planes"
-        )
-    if cfg.blackbox:
-        raise ValueError(
-            "make_split_runner does not thread the black box (v1: "
-            "steady_mask rejects blackbox-on horizons, so nothing would "
-            "fuse) — use make_runner; ClusterSim.run_reconfig(split=True) "
-            "falls back automatically"
-        )
-    if k > cfg.health_window:
-        raise ValueError(
-            f"fused block k={k} exceeds health_window={cfg.health_window}: "
-            "the closed-form health fold handles at most one churn-window "
-            "crossing per block"
-        )
-    _validate_plans(cfg, compiled, chaos_compiled)
-    chaos_on = chaos_compiled is not None
-    segments = split_plan(compiled, k, chaos_compiled, window)
-    assert segments and segments[0].start == 0 and sum(
-        s.rounds for s in segments
-    ) == n_rounds, "split_plan must tile the horizon exactly"
-    fused_fn = pallas_step.steady_round(
-        cfg, rounds=k, with_health=True, with_counters=with_counters,
-        with_chaos=chaos_on, interpret=interpret,
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxprs (GC014 pins it)."""
+    from . import runner as runner_mod
+
+    return runner_mod.make_runner(
+        cfg, (compiled, chaos_compiled), split=True, k=k, window=window,
+        with_counters=with_counters, interpret=interpret,
     )
-    n_carry = 7 if with_counters else 6  # ... + fused accumulator below
-
-    def _unpack_rest(rest):
-        ctrs = rest[0] if with_counters else None
-        i = 1 if with_counters else 0
-        return ctrs, rest[i], rest[i + 1], rest[i + 2:]  # fused, r0, sched
-
-    def general_run(L):
-        def run_gen(st, hl, rst, stats, rstats, safety, *rest):
-            ctrs, fused, r0, sched_args = _unpack_rest(rest)
-            sched, chaos_sched = _rebuild_scheds(
-                compiled, chaos_compiled, sched_args
-            )
-            body = _runner_body(cfg, sched, chaos_sched, with_counters)
-            carry = (st, hl, rst, stats, rstats, safety)
-            if with_counters:
-                carry = carry + (ctrs,)
-            carry, _ = jax.lax.scan(
-                body, carry, r0 + jnp.arange(L, dtype=jnp.int32)
-            )
-            return carry + (fused,)
-
-        return run_gen
-
-    def fused_block_run(st, hl, rst, stats, rstats, safety, *rest):
-        ctrs, fused, r0, sched_args = _unpack_rest(rest)
-        sched, chaos_sched = _rebuild_scheds(
-            compiled, chaos_compiled, sched_args
-        )
-        body = _runner_body(cfg, sched, chaos_sched, with_counters)
-        if chaos_on:
-            link, loss, crashed, capp = chaos_mod.schedule_planes(
-                chaos_sched, r0
-            )
-        else:
-            link = loss = None
-            crashed = jnp.zeros((P, G), bool)
-            capp = 0
-        append = sched.append[sched.phase_of_round[r0]] + capp
-        pend = pending_in_horizon(sched, rst, r0, k)
-        mask = pallas_step.steady_mask(
-            cfg, st, crashed, horizon=k, link=link,
-            reconfig_pending=pend, loss_rate=loss,
-        )
-        pred = jnp.all(mask)
-
-        def fast(args):
-            st, hl, rst, stats, rstats, safety, *c = args
-            prev_ll = hl.planes[kernels.HP_LEADERLESS]
-            fargs = (st, crashed, append)
-            if chaos_on:
-                fargs = fargs + (loss, r0)
-            if with_counters:
-                fargs = fargs + (c[0],)
-            out = fused_fn(*fargs, hl)
-            if with_counters:
-                st2, ctrs2, hl2 = out
-            else:
-                st2, hl2 = out
-            # One closed-form MTTR fold for the whole block: the fused
-            # health fold pins HP_LEADERLESS to 0 every round (a leader
-            # held), so k per-round folds telescope to this single one.
-            stats2 = chaos_mod.update_chaos_stats(
-                stats, prev_ll, hl2.planes[kernels.HP_LEADERLESS]
-            )
-            # No op proposed/gated/applied and no mask moved (predicate):
-            # the op-protocol carry is unchanged except the transition-
-            # audit anchors, which refresh to (unchanged -> current)
-            # exactly like k general no-op rounds would leave them.
-            rst2 = rst._replace(
-                prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
-            )
-            res = (st2, hl2, rst2, stats2, rstats, safety)
-            if with_counters:
-                res = res + (ctrs2,)
-            return res
-
-        def slow(args):
-            carry, _ = jax.lax.scan(
-                body, args, r0 + jnp.arange(k, dtype=jnp.int32)
-            )
-            return carry
-
-        args = (st, hl, rst, stats, rstats, safety)
-        if with_counters:
-            args = args + (ctrs,)
-        carry = jax.lax.cond(pred, fast, slow, args)
-        fused = fused + jnp.where(
-            pred, jnp.int32(k * G), jnp.int32(0)
-        )
-        return carry + (fused,)
-
-    donate = (0, 1, 2) + ((6,) if with_counters else ())
-    fused_jit = jax.jit(fused_block_run, donate_argnums=donate)
-    general_jits: Dict[int, Callable] = {}
-    for seg in segments:
-        if not seg.fused and seg.rounds not in general_jits:
-            general_jits[seg.rounds] = jax.jit(
-                general_run(seg.rounds), donate_argnums=donate
-            )
-    schedule_args = (
-        compiled.phase_of_round, compiled.append, compiled.op_start,
-        compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
-        compiled.tgt_learner, compiled.added, compiled.removed,
-    ) + (
-        (
-            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
-            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
-            chaos_compiled.append,
-        )
-        if chaos_on
-        else ()
-    )
-
-    def runner(st, hl, rst, counters=None):
-        if with_counters and counters is None:
-            raise ValueError(
-                "runner built with_counters=True needs the counters plane"
-            )
-        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
-        rstats = jnp.zeros((N_RECONFIG_STATS,), jnp.int32)
-        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
-        carry = (st, hl, rst, stats, rstats, safety)
-        if with_counters:
-            carry = carry + (counters,)
-        carry = carry + (jnp.int32(0),)  # the fused group-round accumulator
-        for seg in segments:
-            if seg.fused:
-                for b in range(seg.rounds // k):
-                    carry = fused_jit(
-                        *carry,
-                        jnp.int32(seg.start + b * k),
-                        *schedule_args,
-                    )
-            else:
-                carry = general_jits[seg.rounds](
-                    *carry, jnp.int32(seg.start), *schedule_args
-                )
-        stf, hlf, rstf, stats, rstats, safety = carry[:6]
-        ctrs_f = carry[6] if with_counters else None
-        fused = carry[n_carry]
-        # Tail audit — the same one extra fold make_runner does: the scan
-        # body checks each apply's mask transition one round later, so a
-        # final-round apply needs this (prev_commit = final commit keeps
-        # the commit checks inert).
-        safety = safety + kernels.check_safety(
-            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-            stf.commit,
-            voter_mask=stf.voter_mask,
-            outgoing_mask=stf.outgoing_mask,
-            matched=stf.matched,
-            prev_voter_mask=rstf.prev_voter,
-            prev_outgoing_mask=rstf.prev_outgoing,
-        )
-        out = (stf, hlf, rstf, stats, rstats, safety, fused)
-        if with_counters:
-            out = out + (ctrs_f,)
-        return out
-
-    runner.segments = segments  # type: ignore[attr-defined]
-    runner.fused_jit = fused_jit  # type: ignore[attr-defined]
-    runner.general_jits = general_jits  # type: ignore[attr-defined]
-    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
-    return runner
 
 
 def run_plan(

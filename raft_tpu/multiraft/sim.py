@@ -4011,8 +4011,8 @@ class ClusterSim:
         """Jitted `rounds`-round lax.scan with the WHOLE carry donated —
         state (and counter/health extras) double-buffer in place instead of
         paying a fresh allocation + host dispatch per round, the same shape
-        the chaos runner uses (chaos.make_runner).  Cached per (rounds,
-        link-threading).
+        the compiled scenario runners use (runner.make_runner, behind the
+        chaos.make_runner wrapper).  Cached per (rounds, link-threading).
 
         "Donated" here is verified, not assumed: XLA can silently decline
         a donation it cannot alias, so the GC011 trace audit checks every
@@ -4251,11 +4251,15 @@ class ClusterSim:
                 self._chaos_compiled = compiled
                 self._chaos_runner = None
             if self._chaos_runner is None:
-                self._chaos_runner = chaos_mod.make_runner(
-                    self.cfg, compiled
+                from . import runner as runner_mod
+
+                self._chaos_runner = runner_mod.make_runner(
+                    self.cfg, (compiled,)
                 )
             return compiled, self._chaos_runner
-        return compiled, chaos_mod.make_runner(self.cfg, compiled)
+        from . import runner as runner_mod
+
+        return compiled, runner_mod.make_runner(self.cfg, (compiled,))
 
     def run_plan(self, plan=None) -> dict:
         """Execute the attached (or given) chaos plan as ONE jitted
@@ -4399,16 +4403,13 @@ class ClusterSim:
                     chaos_plan, self.cfg.n_groups
                 )
             chaos_compiled = self._shard_chaos_schedule(chaos_compiled)
-            if split:
-                runner = reconfig_mod.make_split_runner(
-                    self.cfg, compiled, chaos_compiled, k=split_k,
-                    window=split_window, with_counters=wc,
-                    interpret=jax.default_backend() == "cpu",
-                )
-            else:
-                runner = reconfig_mod.make_runner(
-                    self.cfg, compiled, chaos_compiled
-                )
+            from . import runner as runner_mod
+
+            runner = runner_mod.make_runner(
+                self.cfg, (compiled, chaos_compiled), split=split,
+                k=split_k, window=split_window, with_counters=wc,
+                interpret=jax.default_backend() == "cpu",
+            )
             self._reconfig_runner = (
                 plan, chaos_plan, compiled, runner, mode,
             )
@@ -4431,9 +4432,11 @@ class ClusterSim:
                         f"plan spans {compiled.n_rounds} rounds but the "
                         f"GC008 drain cap at this batch size is "
                         f"{self._drain_cap} rounds per undrained window; "
-                        "run the plan through reconfig.make_split_runner "
-                        "directly (managing the counter plane yourself) "
-                        "or split the plan"
+                        "run the plan through the unified factory "
+                        "(runner.make_runner with split=True, or its "
+                        "reconfig.make_split_runner wrapper) directly, "
+                        "managing the counter plane yourself — or split "
+                        "the plan"
                     )
             out = runner(
                 self.state, health, rst,
@@ -4577,17 +4580,13 @@ class ClusterSim:
             reconfig_compiled = self._shard_reconfig_schedule(
                 reconfig_compiled
             )
-            if split:
-                runner = workload_mod.make_split_runner(
-                    self.cfg, compiled, k=split_k,
-                    chaos_compiled=chaos_compiled,
-                    reconfig_compiled=reconfig_compiled,
-                    interpret=jax.default_backend() == "cpu",
-                )
-            else:
-                runner = workload_mod.make_runner(
-                    self.cfg, compiled, chaos_compiled, reconfig_compiled
-                )
+            from . import runner as runner_mod
+
+            runner = runner_mod.make_runner(
+                self.cfg, (compiled, chaos_compiled, reconfig_compiled),
+                split=split, k=split_k,
+                interpret=jax.default_backend() == "cpu",
+            )
             self._read_runner = (
                 plan, chaos_plan, reconfig_plan, compiled, runner, mode,
             )

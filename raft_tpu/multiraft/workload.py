@@ -38,6 +38,12 @@ The scalar twin is simref.ReadOracle (per-round receipt parity on the real
 LeaseBased/Safe pumps); :class:`HostClientSchedule` is the numpy half the
 oracle-driven tests walk — built by the SAME `_compile_arrays` walk as the
 device schedule, so the two cannot drift.
+
+Since the runner-registry refactor the compiled runners are BUILT by the
+unified factory (raft_tpu/multiraft/runner.py) from the schedules.py
+registry; :func:`make_runner` / :func:`make_split_runner` here are thin
+behavior-neutral wrappers (GC018 machine-checks the closure, GC014 pins
+the jaxprs).
 """
 
 from __future__ import annotations
@@ -52,7 +58,6 @@ import jax.numpy as jnp
 
 from . import chaos as chaos_mod
 from . import kernels
-from . import reconfig as reconfig_mod
 from . import sim as sim_mod
 from .chaos import GroupSel, _group_mask
 
@@ -393,119 +398,17 @@ def make_runner(
     read_stats[N_READ_STATS], lat_hist[N_LAT_BUCKETS]);
     state/health/rstate/read_carry are donated.  ``runner.jitted`` /
     ``runner.schedule_args`` are exposed for the graftcheck trace audit.
+
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxpr (GC014 pins it).
     """
-    _validate(cfg, client, chaos_compiled, reconfig_compiled)
-    if reconfig_compiled is None:
-        from .autopilot import empty_reconfig_schedule
+    from . import runner as runner_mod
 
-        reconfig_compiled = empty_reconfig_schedule(
-            client.n_rounds, cfg.n_peers, cfg.n_groups
-        )
-    n_rounds = client.n_rounds
-
-    with_bb = cfg.blackbox
-
-    def run(st, hl, rst, rcar, *args):
-        if with_bb:
-            bb, sched_args = args[0], args[1:]
-        else:
-            sched_args = args
-        csched = client._replace(
-            phase_of_round=sched_args[0],
-            read_fire_packed=sched_args[1],
-            read_mode=sched_args[2],
-            append=sched_args[3],
-        )
-        sched, chaos_sched = reconfig_mod._rebuild_scheds(
-            reconfig_compiled, chaos_compiled, sched_args[4:]
-        )
-        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
-        rstats = jnp.zeros((reconfig_mod.N_RECONFIG_STATS,), jnp.int32)
-        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
-        rdstats = jnp.zeros((N_READ_STATS,), jnp.int32)
-        lat_hist = jnp.zeros((N_LAT_BUCKETS,), jnp.int32)
-        body = reconfig_mod._runner_body(
-            cfg, sched, chaos_sched, client=csched
-        )
-        carry = (
-            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
-        )
-        if with_bb:
-            carry = carry + (bb,)
-        carry, _ = jax.lax.scan(
-            body,
-            carry,
-            jnp.arange(n_rounds, dtype=jnp.int32),
-        )
-        if with_bb:
-            carry, bb = carry[:-1], carry[-1]
-        stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats, lat_hist = (
-            carry
-        )
-        # The same tail audit as reconfig.make_runner: a final-round
-        # apply's mask transition is checked one round later, so fold
-        # once more on the final state (commit checks inert).
-        if with_bb:
-            viol = kernels.check_safety_groups(
-                stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-                stf.commit,
-                voter_mask=stf.voter_mask,
-                outgoing_mask=stf.outgoing_mask,
-                matched=stf.matched,
-                prev_voter_mask=rstf.prev_voter,
-                prev_outgoing_mask=rstf.prev_outgoing,
-            )
-            # dtype= keeps the slot sums int32 under x64 (GC007).
-            safety = safety + jnp.sum(viol, axis=1, dtype=jnp.int32)
-            meta, trip = kernels.blackbox_mark(
-                bb.meta, bb.trip_round, bb.round_idx, viol
-            )
-            bb = bb._replace(meta=meta, trip_round=trip)
-            return (
-                stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
-                lat_hist, bb,
-            )
-        safety = safety + kernels.check_safety(
-            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-            stf.commit,
-            voter_mask=stf.voter_mask,
-            outgoing_mask=stf.outgoing_mask,
-            matched=stf.matched,
-            prev_voter_mask=rstf.prev_voter,
-            prev_outgoing_mask=rstf.prev_outgoing,
-        )
-        return (
-            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
-            lat_hist,
-        )
-
-    jitted = jax.jit(
-        run, donate_argnums=(0, 1, 2, 3, 4) if with_bb else (0, 1, 2, 3)
+    return runner_mod.make_runner(
+        cfg, (client, chaos_compiled, reconfig_compiled)
     )
-    schedule_args = (
-        client.phase_of_round, client.read_fire_packed, client.read_mode,
-        client.append,
-        reconfig_compiled.phase_of_round, reconfig_compiled.append,
-        reconfig_compiled.op_start, reconfig_compiled.n_ops,
-        reconfig_compiled.tgt_voter, reconfig_compiled.tgt_outgoing,
-        reconfig_compiled.tgt_learner, reconfig_compiled.added,
-        reconfig_compiled.removed,
-    ) + (
-        (
-            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
-            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
-            chaos_compiled.append,
-        )
-        if chaos_compiled is not None
-        else ()
-    )
-
-    def runner(st, hl, rst, rcar, *bb):
-        return jitted(st, hl, rst, rcar, *bb, *schedule_args)
-
-    runner.jitted = jitted  # type: ignore[attr-defined]
-    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
-    return runner
 
 
 def make_split_runner(
@@ -547,195 +450,18 @@ def make_split_runner(
     a trailing fused-group-rounds int32 scalar:
     (st, hl, rst, rcar) -> (..., lat_hist, fused_rounds).
     ``runner.fused_jit`` / ``runner.schedule_args`` are exposed for the
-    graftcheck trace audit."""
-    from . import pallas_step
+    graftcheck trace audit.
 
-    if chaos_compiled is not None or reconfig_compiled is not None:
-        raise ValueError(
-            "make_split_runner runs bare client plans; compose chaos/"
-            "reconfig schedules through make_runner (or the reconfig "
-            "split machinery) instead"
-        )
-    if cfg.blackbox:
-        raise ValueError(
-            "make_split_runner does not thread the black box (v1: "
-            "steady_mask rejects blackbox-on horizons, so nothing would "
-            "fuse) — use make_runner; ClusterSim.run_reads(split=True) "
-            "falls back automatically"
-        )
-    if not cfg.collect_health:
-        raise ValueError(
-            "make_split_runner needs SimConfig(collect_health=True) — "
-            "the MTTR stats and the fused block's closed-form fold ride "
-            "on the health planes"
-        )
-    if k > cfg.health_window:
-        raise ValueError(
-            f"fused block k={k} exceeds health_window="
-            f"{cfg.health_window}: the closed-form health fold handles "
-            "at most one churn-window crossing per block"
-        )
-    _validate(cfg, client, None, None)
-    from .autopilot import empty_reconfig_schedule
+    Thin behavior-neutral wrapper since the runner-registry refactor:
+    the construction lives in the unified factory
+    (raft_tpu/multiraft/runner.py), instantiated from the schedules.py
+    registry — byte-identical jaxprs (GC014 pins it)."""
+    from . import runner as runner_mod
 
-    reconfig_sched = empty_reconfig_schedule(
-        client.n_rounds, cfg.n_peers, cfg.n_groups
+    return runner_mod.make_runner(
+        cfg, (client, chaos_compiled, reconfig_compiled), split=True,
+        k=k, interpret=interpret,
     )
-    n_rounds = client.n_rounds
-    P, G = cfg.n_peers, cfg.n_groups
-    n_blocks, tail = n_rounds // k, n_rounds % k
-    fused_fn = pallas_step.steady_round(
-        cfg, rounds=k, with_health=True, interpret=interpret
-    )
-
-    def _rebuild(sched_args):
-        csched = client._replace(
-            phase_of_round=sched_args[0],
-            read_fire_packed=sched_args[1],
-            read_mode=sched_args[2],
-            append=sched_args[3],
-        )
-        sched, _ = reconfig_mod._rebuild_scheds(
-            reconfig_sched, None, sched_args[4:]
-        )
-        return csched, sched
-
-    def block_run(
-        st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
-        fused, r0, *sched_args,
-    ):
-        csched, sched = _rebuild(sched_args)
-        body = reconfig_mod._runner_body(cfg, sched, None, client=csched)
-        crashed = jnp.zeros((P, G), bool)
-        cph = csched.phase_of_round[r0]
-        append = sched.append[sched.phase_of_round[r0]] + csched.append[cph]
-        same_phase = cph == csched.phase_of_round[r0 + k - 1]
-        read_block = reads_pending_in_horizon(csched, rcar, r0, k)
-        n_lease, any_lease = lease_fires_in_block(csched, r0, k)
-        _, lease_entry, _ = kernels.lease_read(
-            st.state, st.term, st.leader_id, st.election_elapsed,
-            st.commit, st.term_start_index, crashed, cfg.election_tick,
-            cfg.check_quorum and cfg.lease_read, st.transferee,
-            st.recent_active, st.voter_mask, st.outgoing_mask,
-        )
-        # A lease fire is provably servable across the block when the
-        # gate passes at entry and the per-round heartbeat acks keep the
-        # recent_active row saturated between boundary clears — which
-        # needs heartbeat_tick == 1 (static); otherwise lease blocks
-        # honestly fall back.
-        lease_prov = ~any_lease | (
-            lease_entry
-            if cfg.heartbeat_tick == 1
-            else jnp.zeros((G,), bool)
-        )
-        mask = pallas_step.steady_mask(
-            cfg, st, crashed, horizon=k, read_pending=read_block
-        )
-        pred = jnp.all(mask & lease_prov) & same_phase
-
-        def fast(args):
-            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat = args
-            prev_ll = hl.planes[kernels.HP_LEADERLESS]
-            st2, hl2 = fused_fn(st, crashed, append, hl)
-            stats2 = chaos_mod.update_chaos_stats(
-                stats, prev_ll, hl2.planes[kernels.HP_LEADERLESS]
-            )
-            # The op protocol provably never moves (no-op schedule); only
-            # the transition-audit anchors refresh, like the reconfig
-            # split runner's fast arm.
-            rst2 = rst._replace(
-                prev_voter=st2.voter_mask, prev_outgoing=st2.outgoing_mask
-            )
-            # Closed-form receipts: every in-block lease fire issues
-            # fresh (the carry is provably empty — read_block rejected
-            # otherwise) and serves the round it fires at latency 0.
-            n_served = jnp.sum(n_lease, dtype=jnp.int32)
-            lat = lat.at[0].add(n_served)
-            rdstats2 = rdstats.at[RS_ISSUED].add(n_served)
-            rdstats2 = rdstats2.at[RS_SERVED_LEASE].add(n_served)
-            return (
-                st2, hl2, rst2, stats2, rstats, safety, rcar, rdstats2,
-                lat,
-            )
-
-        def slow(args):
-            carry, _ = jax.lax.scan(
-                body, args, r0 + jnp.arange(k, dtype=jnp.int32)
-            )
-            return carry
-
-        args = (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist)
-        carry = jax.lax.cond(pred, fast, slow, args)
-        fused = fused + jnp.where(pred, jnp.int32(k * G), jnp.int32(0))
-        return carry + (fused,)
-
-    def tail_run(
-        st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
-        fused, r0, *sched_args,
-    ):
-        csched, sched = _rebuild(sched_args)
-        body = reconfig_mod._runner_body(cfg, sched, None, client=csched)
-        carry, _ = jax.lax.scan(
-            body,
-            (st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist),
-            r0 + jnp.arange(tail, dtype=jnp.int32),
-        )
-        return carry + (fused,)
-
-    donate = (0, 1, 2, 6)
-    fused_jit = jax.jit(block_run, donate_argnums=donate)
-    tail_jit = jax.jit(tail_run, donate_argnums=donate) if tail else None
-    schedule_args = (
-        client.phase_of_round, client.read_fire_packed, client.read_mode,
-        client.append,
-        reconfig_sched.phase_of_round, reconfig_sched.append,
-        reconfig_sched.op_start, reconfig_sched.n_ops,
-        reconfig_sched.tgt_voter, reconfig_sched.tgt_outgoing,
-        reconfig_sched.tgt_learner, reconfig_sched.added,
-        reconfig_sched.removed,
-    )
-
-    def runner(st, hl, rst, rcar):
-        stats = jnp.zeros((chaos_mod.N_CHAOS_STATS,), jnp.int32)
-        rstats = jnp.zeros((reconfig_mod.N_RECONFIG_STATS,), jnp.int32)
-        safety = jnp.zeros((kernels.N_SAFETY,), jnp.int32)
-        rdstats = jnp.zeros((N_READ_STATS,), jnp.int32)
-        lat_hist = jnp.zeros((N_LAT_BUCKETS,), jnp.int32)
-        carry = (
-            st, hl, rst, stats, rstats, safety, rcar, rdstats, lat_hist,
-            jnp.int32(0),
-        )
-        for b in range(n_blocks):
-            carry = fused_jit(
-                *carry, jnp.int32(b * k), *schedule_args
-            )
-        if tail_jit is not None:
-            carry = tail_jit(
-                *carry, jnp.int32(n_blocks * k), *schedule_args
-            )
-        (
-            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
-            lat_hist, fused,
-        ) = carry
-        # make_runner's tail audit (a final-round apply transition —
-        # inert here with the no-op schedule, kept for bit-parity).
-        safety = safety + kernels.check_safety(
-            stf.state, stf.term, stf.commit, stf.last_index, stf.agree,
-            stf.commit,
-            voter_mask=stf.voter_mask,
-            outgoing_mask=stf.outgoing_mask,
-            matched=stf.matched,
-            prev_voter_mask=rstf.prev_voter,
-            prev_outgoing_mask=rstf.prev_outgoing,
-        )
-        return (
-            stf, hlf, rstf, stats, rstats, safety, rcarf, rdstats,
-            lat_hist, fused,
-        )
-
-    runner.fused_jit = fused_jit  # type: ignore[attr-defined]
-    runner.schedule_args = schedule_args  # type: ignore[attr-defined]
-    return runner
 
 
 def reads_pending_in_horizon(

@@ -2042,3 +2042,394 @@ def test_gc017_fix_markers_rewrites_files(tmp_path):
     assert "justification wraps" not in out
     assert "x = jnp.zeros((4,), dtype=jnp.int32)\n" in out
     assert "y = jnp.zeros((2,), dtype=jnp.int32)\n" in out
+
+
+# --- PR 19 runner registry: GC018 runner-closure + GC019 phase-budget
+
+
+# A minimal-but-complete fixture schedule registry: GC018 standalone-loads
+# the SCANNED schedules.py (the GC016 discipline), so every accessor
+# check_runners calls must exist.  `{extra_row}` lets tests inject an
+# orphan registry row.
+_FIXTURE_SCHEDULES = '''\
+from typing import NamedTuple, Tuple
+
+
+class ScheduleSpec(NamedTuple):
+    name: str
+    family: str
+    shape: str
+    dtype: str
+    packing: str = ""
+    gather: str = "phase"
+    flag: Tuple[str, ...] = ()
+
+    @property
+    def anchor_text(self):
+        return self.dtype + self.shape
+
+
+class ScheduleFamily(NamedTuple):
+    name: str
+    compiled: str
+    host_twin: str
+    phase: str
+
+
+class RunnerVariant(NamedTuple):
+    name: str
+    base: str
+    phases: Tuple[str, ...]
+    builder: str
+    options: Tuple = ()
+    probe_for: str = ""
+
+
+PHASES = ("chaos",)
+PHASE_TOLERANCE_PCT = 2.0
+
+SCHEDULES = (
+    ScheduleSpec("phase_of_round", "chaos", "[R]", "int32", gather="round"),
+    ScheduleSpec("link_packed", "chaos", "[S, W, G]", "uint32"),
+    ScheduleSpec("append", "chaos", "[S, G]", "int32"),{extra_row}
+)
+
+FAMILIES = (
+    ScheduleFamily(
+        "chaos", "chaos.CompiledChaos", "chaos.HostSchedule", "chaos"
+    ),
+)
+
+RUNNER_VARIANTS = (
+    RunnerVariant(
+        "chaos_runner", "step", ("chaos",), "chaos", probe_for="chaos"
+    ),
+)
+
+
+def rows(family=None):
+    return tuple(
+        r for r in SCHEDULES if family is None or r.family == family
+    )
+
+
+def row(family_name, name):
+    for r in SCHEDULES:
+        if r.family == family_name and r.name == name:
+            return r
+    raise KeyError((family_name, name))
+
+
+def families():
+    return FAMILIES
+
+
+def family(name):
+    for f in FAMILIES:
+        if f.name == name:
+            return f
+    raise KeyError(name)
+
+
+def array_fields(family_name):
+    return tuple(r.name for r in rows(family_name))
+
+
+def runner_variants():
+    return RUNNER_VARIANTS
+
+
+def variant(name):
+    for v in RUNNER_VARIANTS:
+        if v.name == name:
+            return v
+    raise KeyError(name)
+
+
+def phases():
+    return PHASES
+
+
+def gating_flags():
+    out = []
+    for r in SCHEDULES:
+        for f in r.flag:
+            if f not in out:
+                out.append(f)
+    return tuple(out)
+
+
+def packing_families():
+    out = []
+    for r in SCHEDULES:
+        if r.packing and r.packing not in out:
+            out.append(r.packing)
+    return tuple(out)
+'''
+
+_FIXTURE_CHAOS = '''\
+"""fixture chaos"""
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class CompiledChaos(NamedTuple):
+    phase_of_round: jnp.ndarray  # gc: int32[R]
+    link_packed: jnp.ndarray  # gc: uint32[S, W, G]
+    append: jnp.ndarray  # gc: int32[S, G]
+    n_rounds: int = 0
+
+
+class HostSchedule:
+    pass
+'''
+
+
+def schedules_fixture(extra_row=""):
+    return _FIXTURE_SCHEDULES.format(extra_row=extra_row)
+
+
+def gc018(vs):
+    return [v for v in vs if v.rule_id == "GC018"]
+
+
+def test_gc018_matching_tree_passes(tmp_path):
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+        },
+    )
+    assert gc018(vs) == []
+
+
+def test_gc018_orphan_registry_row_flags(tmp_path):
+    # A registry row with no compiled-tuple field desyncs the family.
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(
+                extra_row='\n    ScheduleSpec('
+                '"loss_packed", "chaos", "[S, W, G]", "uint32"),'
+            ),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+        },
+    )
+    assert any("orphan registry row" in v.message for v in gc018(vs))
+
+
+def test_gc018_closure_const_schedule_flags(tmp_path):
+    # A nested (traced) def reading a schedule array off an enclosing-
+    # scope object is the source-level GC012 constant-capture hazard.
+    runner = (
+        '"""fixture runner"""\n'
+        "from . import schedules\n\n\n"
+        "def make_runner(cfg, compiled):\n"
+        '    fields = schedules.array_fields("chaos")\n\n'
+        "    def run(st):\n"
+        "        return st + compiled.link_packed.sum()\n\n"
+        "    return run\n"
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+            "raft_tpu/multiraft/runner.py": runner,
+        },
+    )
+    assert any("closure variable" in v.message for v in gc018(vs))
+
+
+def test_gc018_runtime_arg_schedule_in_nested_def_passes(tmp_path):
+    # The same read is fine when the schedule object is the nested
+    # function's OWN parameter — a runtime jit arg, not a closure const.
+    runner = (
+        '"""fixture runner"""\n'
+        "from . import schedules\n\n\n"
+        "def make_runner(cfg, compiled):\n"
+        '    fields = schedules.array_fields("chaos")\n\n'
+        "    def run(st, sched):\n"
+        "        return st + sched.link_packed.sum()\n\n"
+        "    return run\n"
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+            "raft_tpu/multiraft/runner.py": runner,
+        },
+    )
+    assert gc018(vs) == []
+
+
+def test_gc018_hand_listed_schedule_tuple_flags(tmp_path):
+    # Re-enumerating three family arrays off one object in a Load-context
+    # display is the drift the registry exists to delete.
+    runner = (
+        '"""fixture runner"""\n'
+        "from . import schedules\n\n\n"
+        "def make_runner(cfg, compiled):\n"
+        '    fields = schedules.array_fields("chaos")\n'
+        "    flat = (\n"
+        "        compiled.phase_of_round,\n"
+        "        compiled.link_packed,\n"
+        "        compiled.append,\n"
+        "    )\n"
+        "    return flat\n"
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+            "raft_tpu/multiraft/runner.py": runner,
+        },
+    )
+    assert any("hand-listed schedule tuple" in v.message for v in gc018(vs))
+
+
+def test_gc018_hand_listed_inventory_row_flags(tmp_path):
+    # A fixture linter checkout whose inventory.py regrew a hand-listed
+    # runner row (and dropped the runner_variants() derivation): the
+    # check reads repo_root/tools/..., which run_engine_on points at
+    # tmp_path.
+    bad = tmp_path / "tools" / "graftcheck" / "trace" / "inventory.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        'GRAPHS = [("chaos_runner", "raft_tpu/multiraft/chaos.py")]\n'
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+        },
+    )
+    msgs = [v.message for v in gc018(vs)]
+    assert any("does not call runner_variants()" in m for m in msgs)
+    assert any("hand-listed runner graph row" in m for m in msgs)
+
+
+def test_gc018_derived_inventory_passes(tmp_path):
+    good = tmp_path / "tools" / "graftcheck" / "trace" / "inventory.py"
+    good.parent.mkdir(parents=True)
+    good.write_text(
+        "def _runner_specs(schedules):\n"
+        "    return [v.name for v in schedules.runner_variants()]\n"
+    )
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": schedules_fixture(),
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+        },
+    )
+    assert gc018(vs) == []
+
+
+def test_gc018_missing_probe_flags(tmp_path):
+    sched = schedules_fixture().replace('probe_for="chaos"', 'probe_for=""')
+    vs = run_engine_on(
+        tmp_path,
+        {
+            "raft_tpu/multiraft/schedules.py": sched,
+            "raft_tpu/multiraft/chaos.py": _FIXTURE_CHAOS,
+        },
+    )
+    assert any("probe" in v.message for v in gc018(vs))
+
+
+# --- GC019 phase-budget (stdlib unit tests over check_phase_budget) ---
+
+
+from tools.graftcheck.trace import budget as budget_mod  # noqa: E402
+
+
+def _gc019_fixture():
+    from raft_tpu.multiraft import schedules
+
+    var = schedules.RunnerVariant(
+        name="chaos_runner", base="step", phases=("chaos",),
+        builder="chaos", probe_for="chaos",
+    )
+    doc = {
+        "phases": {"chaos": 90},
+        "runners": {
+            "chaos_runner": {
+                "base": "step", "phases": ["chaos"], "predicted": 190,
+                "residual_pct": 0.0,
+            },
+        },
+        "phase_tolerance_pct": 2.0,
+    }
+    return var, doc
+
+
+def test_gc019_within_tolerance_passes():
+    var, doc = _gc019_fixture()
+    measured = {"step": 100, "chaos_runner": 192}  # +1.05% residual
+    vs, diff = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var]
+    )
+    assert vs == []
+    assert diff["runners"]["chaos_runner"]["status"] == "ok"
+
+
+def test_gc019_phase_overrun_flags():
+    # The duplicated-lowering failure mode: the variant's eqn count
+    # outgrows base + phase budgets past the recorded residual.
+    var, doc = _gc019_fixture()
+    measured = {"step": 100, "chaos_runner": 240}  # +26.3% residual
+    vs, diff = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var]
+    )
+    assert len(vs) == 1
+    assert vs[0].rule_id == "GC019"
+    assert "lowered more than once" in vs[0].message
+    assert diff["runners"]["chaos_runner"]["status"] == "over"
+
+
+def test_gc019_shrinkage_never_fails():
+    var, doc = _gc019_fixture()
+    measured = {"step": 100, "chaos_runner": 150}  # well under predicted
+    vs, diff = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var]
+    )
+    assert vs == []
+
+
+def test_gc019_unrecorded_variant_flags():
+    var, doc = _gc019_fixture()
+    doc = dict(doc, runners={})
+    measured = {"step": 100, "chaos_runner": 192}
+    vs, _ = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var]
+    )
+    assert any("no recorded GC019 residual" in v.message for v in vs)
+
+
+def test_gc019_missing_sections_flag():
+    var, _ = _gc019_fixture()
+    vs, _ = budget_mod.check_phase_budget(
+        {"step": 100, "chaos_runner": 192}, {"graphs": {}},
+        "jaxpr_budget.json", [var],
+    )
+    assert any("phase decomposition" in v.message for v in vs)
+
+
+def test_gc019_stale_entry_only_on_full_registry():
+    var, doc = _gc019_fixture()
+    doc["runners"]["ghost_runner"] = dict(doc["runners"]["chaos_runner"])
+    measured = {"step": 100, "chaos_runner": 192}
+    vs_full, _ = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var], full_registry=True
+    )
+    assert any("ghost_runner" in v.message for v in vs_full)
+    vs_part, _ = budget_mod.check_phase_budget(
+        measured, doc, "jaxpr_budget.json", [var], full_registry=False
+    )
+    assert not any("ghost_runner" in v.message for v in vs_part)

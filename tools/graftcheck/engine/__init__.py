@@ -23,6 +23,7 @@ from ..core import (
 from . import obligations as obligations_mod
 from . import overflow
 from . import registry as registry_mod
+from . import runners as runners_mod
 from . import stale as stale_mod
 from .interp import build_program
 from .rules import check_traced_escape, engine_rules
@@ -84,6 +85,9 @@ def _engine_raw(
 
     # GC016: plane-registry closure.
     violations.extend(registry_mod.check_registry(files, ctx))
+
+    # GC018: schedule-registry / unified-runner closure.
+    violations.extend(runners_mod.check_runners(files, ctx))
     return violations
 
 

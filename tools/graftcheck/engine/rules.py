@@ -83,6 +83,19 @@ class RegistryClosureRule(Rule):
         return False
 
 
+class RunnerClosureRule(Rule):
+    id = "GC018"
+    slug = "runner-closure"
+    doc = (
+        "every schedules.py row binds a compiled-tuple field, a host "
+        "twin, and a runtime jit arg of the unified runner; inventory "
+        "rows derive from the registry (--engine)"
+    )
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
 class StaleMarkerRule(Rule):
     id = "GC017"
     slug = "stale-marker"
@@ -103,6 +116,7 @@ def engine_rules() -> List[Rule]:
         TracedEscapeRule(),
         ParityObligationsRule(),
         RegistryClosureRule(),
+        RunnerClosureRule(),
         StaleMarkerRule(),
     ]
 

@@ -305,7 +305,8 @@ def check_consts(spec: GraphSpec, closed) -> Iterator[Violation]:
             f"({dtype}{list(shape)}) into its jaxpr (budget "
             f"{spec.const_budget}B) — a closed-over plane is HBM-resident "
             "per executable and defeats the compile cache; pass it as an "
-            "argument (cf. chaos.make_runner's schedule args)",
+            "argument (cf. runner.schedule_args, the registry-derived "
+            "flat schedule tuple)",
         )
 
 
@@ -594,13 +595,22 @@ def run_trace(
     import json
     from pathlib import Path
 
+    from raft_tpu.multiraft import schedules
+
     violations, measured = trace_inventory(specs)
+    variants = schedules.runner_variants()
     bpath = budget_mod.budget_path(ctx.repo_root)
     versions = jax_versions()
     if update_budget:
         bpath.parent.mkdir(parents=True, exist_ok=True)
+        phase_doc = budget_mod.derive_phase_doc(
+            measured, variants, schedules.PHASE_TOLERANCE_PCT
+        )
         bpath.write_text(
-            budget_mod.render_budget(measured, versions), encoding="utf-8"
+            budget_mod.render_budget(
+                measured, versions, phase_doc=phase_doc
+            ),
+            encoding="utf-8",
         )
     doc = budget_mod.load_budget(bpath)
     anchor = "tools/graftcheck/" + budget_mod.BUDGET_NAME
@@ -608,6 +618,11 @@ def run_trace(
         measured, doc, anchor, measured_versions=versions
     )
     violations.extend(budget_violations)
+    phase_violations, phase_diff = budget_mod.check_phase_budget(
+        measured, doc, anchor, variants, full_registry=specs is None
+    )
+    violations.extend(phase_violations)
+    diff["phase_budget"] = phase_diff
     if diff.get("version_mismatch"):
         import sys
 

@@ -44,6 +44,10 @@ DEFAULT_TOLERANCE_PCT = 15.0
 GC014 = "GC014"
 GC014_SLUG = "jaxpr-budget"
 
+GC019 = "GC019"
+GC019_SLUG = "phase-budget"
+DEFAULT_PHASE_TOLERANCE_PCT = 2.0
+
 
 def budget_path(repo_root: Path) -> Path:
     return repo_root / "tools" / "graftcheck" / BUDGET_NAME
@@ -67,6 +71,7 @@ def load_budget(path: Path) -> Optional[dict]:
 def render_budget(
     measured: Dict[str, int], versions: Dict[str, str],
     tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+    phase_doc: Optional[dict] = None,
 ) -> str:
     doc = {
         "format": BUDGET_FORMAT,
@@ -76,7 +81,173 @@ def render_budget(
             name: {"eqns": int(n)} for name, n in sorted(measured.items())
         },
     }
+    if phase_doc:
+        doc.update(phase_doc)
     return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+# --- GC019: the phase-budget decomposition -----------------------------------
+#
+# Each runner variant's eqn count must decompose (within tolerance) into
+# eqns(base graph) + sum(registered phase-kernel budgets) — so a phase
+# accidentally lowered TWICE into one runner variant (a duplicated chaos
+# gather, a re-traced client arm) fails the build even when the total
+# still clears GC014's 15% growth gate.  `variants` rows are
+# schedules.RunnerVariant-shaped (name/base/phases/probe_for); the logic
+# stays stdlib so the unit tests and the negative fixture run jax-less.
+
+
+def derive_phase_doc(
+    measured: Dict[str, int],
+    variants,
+    tolerance_pct: float = DEFAULT_PHASE_TOLERANCE_PCT,
+) -> dict:
+    """The committed GC019 sections, derived at regen time: each phase's
+    eqn budget is defined by its unique probe variant (phase =
+    eqns(probe) - eqns(base) - other registered phases, clamped at 0),
+    in registry declaration order — GC018 pins exactly one probe per
+    phase, and probes for composite variants come after the probes of
+    the phases they ride on.  Every variant's residual (measured vs
+    base + sum(phases)) is recorded so the check can gate GROWTH of the
+    residual rather than its absolute value (base graphs and runner
+    graphs share lowering that never decomposes exactly)."""
+    phases: Dict[str, int] = {}
+    runners: Dict[str, dict] = {}
+    for v in variants:
+        if not v.probe_for:
+            continue
+        base = measured.get(v.base)
+        own = measured.get(v.name)
+        if base is None or own is None:
+            continue
+        others = sum(
+            phases.get(p, 0) for p in v.phases if p != v.probe_for
+        )
+        phases[v.probe_for] = max(0, own - base - others)
+    for v in variants:
+        base = measured.get(v.base)
+        own = measured.get(v.name)
+        if base is None or own is None:
+            continue
+        predicted = base + sum(phases.get(p, 0) for p in v.phases)
+        residual = (
+            (own - predicted) * 100.0 / predicted if predicted else 0.0
+        )
+        runners[v.name] = {
+            "base": v.base,
+            "phases": list(v.phases),
+            "predicted": int(predicted),
+            "residual_pct": round(residual, 2),
+        }
+    return {
+        "phases": phases,
+        "runners": runners,
+        "phase_tolerance_pct": tolerance_pct,
+    }
+
+
+def check_phase_budget(
+    measured: Dict[str, int],
+    doc: Optional[dict],
+    anchor_path: str,
+    variants,
+    full_registry: bool = True,
+) -> Tuple[List[Violation], dict]:
+    """GC019 over one measurement: recompute each variant's residual
+    against the committed phase budgets and fail any variant whose
+    residual GREW past the recorded one by more than the committed
+    tolerance (percentage points).  Shrinkage never fails (the GC014
+    convention).  On a partial run (fixture specs, --rule subsets)
+    variants whose graphs were not traced are skipped, and stale
+    `runners` entries are only reported on the full-registry run."""
+
+    def v(line_msg: str) -> Violation:
+        return Violation(anchor_path, 1, GC019, GC019_SLUG, line_msg)
+
+    violations: List[Violation] = []
+    diff: dict = {"runners": {}}
+    if doc is None:
+        return violations, diff  # GC014 already reports the missing budget
+    phases = doc.get("phases")
+    runners = doc.get("runners")
+    if not isinstance(phases, dict) or not isinstance(runners, dict):
+        violations.append(
+            v(
+                "committed budget has no GC019 phase decomposition "
+                "('phases'/'runners' sections) — regenerate with "
+                "`make jaxpr-budget` and commit it"
+            )
+        )
+        return violations, diff
+    tolerance = float(
+        doc.get("phase_tolerance_pct", DEFAULT_PHASE_TOLERANCE_PCT)
+    )
+    diff["phase_tolerance_pct"] = tolerance
+    diff["phases"] = dict(phases)
+    for var in variants:
+        own = measured.get(var.name)
+        base = measured.get(var.base)
+        if own is None or base is None:
+            continue  # partial run: the variant's graphs were not traced
+        predicted = base + sum(int(phases.get(p, 0)) for p in var.phases)
+        residual = (
+            (own - predicted) * 100.0 / predicted if predicted else 0.0
+        )
+        entry = runners.get(var.name)
+        if not isinstance(entry, dict) or "residual_pct" not in entry:
+            violations.append(
+                v(
+                    f"runner variant {var.name!r} has no recorded GC019 "
+                    "residual — every variant's phase decomposition must "
+                    "be committed in the PR that adds it "
+                    "(`make jaxpr-budget`)"
+                )
+            )
+            diff["runners"][var.name] = {
+                "recorded": None,
+                "residual_pct": round(residual, 2),
+                "status": "new",
+            }
+            continue
+        recorded = float(entry["residual_pct"])
+        status = "ok"
+        if residual > recorded + tolerance:
+            status = "over"
+            violations.append(
+                v(
+                    f"runner variant {var.name!r} traced to {own} eqns "
+                    f"but its phase decomposition predicts {predicted} "
+                    f"(base {var.base!r} = {base} + phases "
+                    f"{list(var.phases)}): residual {residual:+.2f}% vs "
+                    f"recorded {recorded:+.2f}% (tolerance "
+                    f"{tolerance:.1f} pts) — a phase is lowered more "
+                    "than once into this variant (or a phase kernel "
+                    "grew without its probe moving); deduplicate the "
+                    "lowering or pay for it visibly with "
+                    "`make jaxpr-budget`"
+                )
+            )
+        elif residual < recorded - tolerance:
+            status = "shrunk"
+        diff["runners"][var.name] = {
+            "recorded": recorded,
+            "residual_pct": round(residual, 2),
+            "status": status,
+        }
+    if full_registry:
+        # Stale = names no REGISTERED variant (a variant whose build
+        # failed is a GC000 finding, not a stale entry).
+        registered = {var.name for var in variants}
+        for name in sorted(set(runners) - registered):
+            violations.append(
+                v(
+                    f"GC019 `runners` entry {name!r} names no registered "
+                    "runner variant — stale after a registry change; "
+                    "regenerate with `make jaxpr-budget`"
+                )
+            )
+            diff["runners"][name] = {"status": "stale"}
+    return violations, diff
 
 
 def check_budget(

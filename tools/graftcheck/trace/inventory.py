@@ -31,7 +31,7 @@ allow-marker (GC000's discipline).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, NamedTuple, Tuple
 
 # The audit shape: tiny on purpose (see module docstring).
@@ -138,6 +138,28 @@ def _sim():
     from raft_tpu.multiraft import sim
 
     return sim
+
+
+def _schedules_mod():
+    """raft_tpu/multiraft/schedules.py loaded standalone by file path —
+    the registry is stdlib-only by contract (GC018 leg (a) re-verifies
+    that on every engine run), and loading it this way keeps this module
+    importable in jax-less environments: going through the package would
+    pull ``raft_tpu.multiraft.__init__`` and with it jax."""
+    import importlib.util
+    from pathlib import Path
+
+    path = (
+        Path(__file__).resolve().parents[3]
+        / "raft_tpu" / "multiraft" / "schedules.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "_graftcheck_schedules", path
+    )
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _base_args(cfg):
@@ -307,13 +329,18 @@ def _blackbox_step_builder():
     return build
 
 
-def _reconfig_runner_builder(with_chaos: bool, damping: dict):
+def _reconfig_runner_builder(
+    with_chaos: bool = False, damping: bool = False
+):
     def build() -> Built:
         from raft_tpu.multiraft import chaos, reconfig
 
         sim = _sim()
+        dflags = (
+            {"check_quorum": True, "pre_vote": True} if damping else {}
+        )
         cfg = sim.SimConfig(
-            n_groups=G, n_peers=P, collect_health=True, **damping
+            n_groups=G, n_peers=P, collect_health=True, **dflags
         )
         plan = reconfig.ReconfigPlan(
             name="graftcheck-inventory",
@@ -472,6 +499,10 @@ def _autopilot_runner_builder():
             cfg, compiled, chaos_compiled, SCAN_ROUNDS
         )
         st, _, _ = _base_args(cfg)
+        from raft_tpu.multiraft import runner as runner_mod
+
+        # The flat schedule tail comes from the registry
+        # (runner.schedule_args) — never hand-listed (GC018).
         args = (
             st, sim.init_health(cfg), reconfig.init_reconfig_state(st),
             jnp.zeros((chaos.N_CHAOS_STATS,), jnp.int32),
@@ -481,13 +512,7 @@ def _autopilot_runner_builder():
             jnp.int32(0),
             jnp.zeros((G,), jnp.int32),
             jnp.zeros((P, G), bool),
-            compiled.phase_of_round, compiled.append, compiled.op_start,
-            compiled.n_ops, compiled.tgt_voter, compiled.tgt_outgoing,
-            compiled.tgt_learner, compiled.added, compiled.removed,
-            chaos_compiled.phase_of_round, chaos_compiled.link_packed,
-            chaos_compiled.loss_packed, chaos_compiled.crashed_packed,
-            chaos_compiled.append,
-        )
+        ) + runner_mod.schedule_args(compiled, chaos_compiled)
         return Built(runner, args, (0, 1, 2, 3, 4, 5, 6))
 
     return build
@@ -729,6 +754,51 @@ def _sharded_dispatch_builder():
 
 # --- the registry -----------------------------------------------------------
 
+# builder key (schedules.RunnerVariant.builder) -> the local builder
+# factory.  The compiled-runner GraphSpec rows below are DERIVED from
+# raft_tpu/multiraft/schedules.py's RUNNER_VARIANTS through this map —
+# GC018 forbids hand-listing a runner graph here (no string literal in
+# this module may equal a runner-variant name), so a new runner variant
+# lands as one registry row and its trace gates (GC011-GC014, GC019)
+# come for free.
+_RUNNER_BUILDERS: Dict[str, Callable[..., Callable[[], Built]]] = {
+    "chaos": _chaos_runner_builder,
+    "reconfig": _reconfig_runner_builder,
+    "reconfig_split": _split_runner_builder,
+    "workload": _workload_runner_builder,
+    "workload_split": _workload_split_builder,
+    "autopilot": _autopilot_runner_builder,
+}
+
+# builder key -> the repo-relative module the variant's legacy entry
+# point (now a thin wrapper over runner.make_runner) lives in.
+_RUNNER_ANCHORS: Dict[str, str] = {
+    "chaos": "raft_tpu/multiraft/chaos.py",
+    "reconfig": "raft_tpu/multiraft/reconfig.py",
+    "reconfig_split": "raft_tpu/multiraft/reconfig.py",
+    "workload": "raft_tpu/multiraft/workload.py",
+    "workload_split": "raft_tpu/multiraft/workload.py",
+    "autopilot": "raft_tpu/multiraft/autopilot.py",
+}
+
+
+def _runner_specs() -> List[GraphSpec]:
+    """One GraphSpec per schedules.RUNNER_VARIANTS row: names, builder
+    selection, and builder options all come from the schedule registry
+    (the ROADMAP item 5 source-of-truth promotion, runner half)."""
+    schedules = _schedules_mod()
+    return [
+        GraphSpec(
+            name=variant.name,
+            anchor=_RUNNER_ANCHORS[variant.builder],
+            build=_RUNNER_BUILDERS[variant.builder](
+                **dict(variant.options)
+            ),
+        )
+        for variant in schedules.runner_variants()
+    ]
+
+
 _INSTRUMENT_FLAGS: List[Tuple[str, dict, bool]] = [
     # (label, SimConfig flags, link plane threaded)
     ("plain", {}, False),
@@ -802,16 +872,6 @@ def _specs() -> List[GraphSpec]:
     )
     out.append(
         GraphSpec(
-            # The autopilot's cadence segment (ISSUE 12): chaos masks +
-            # the reconfig op protocol + action planes + the
-            # commit-stall fold in one donated scan.
-            name="autopilot_cadence@health+chaos+transfer",
-            anchor="raft_tpu/multiraft/autopilot.py",
-            build=_autopilot_runner_builder(),
-        )
-    )
-    out.append(
-        GraphSpec(
             name="read_index@plain", anchor=sim_py,
             build=_read_index_builder(False),
         )
@@ -832,27 +892,6 @@ def _specs() -> List[GraphSpec]:
             anchor=sim_py,
             build=_read_step_builder(),
             audit_donation=False,
-        )
-    )
-    workload_py = "raft_tpu/multiraft/workload.py"
-    out.append(
-        GraphSpec(
-            # The ISSUE 13 compiled client-workload scan: state + health
-            # + op carry + read carry all donated; schedule arrays are
-            # runtime args (the GC012 lesson, applied from birth).
-            name="workload_runner@health+reads+cq",
-            anchor=workload_py,
-            build=_workload_runner_builder(),
-        )
-    )
-    out.append(
-        GraphSpec(
-            # The split-fused read block: fused damped kernel +
-            # closed-form lease receipts + the general fallback under one
-            # cond, carry donated end to end.
-            name=f"workload_split{DISPATCH_K}@health+reads+cq",
-            anchor=workload_py,
-            build=_workload_split_builder(),
         )
     )
     pallas_py = "raft_tpu/multiraft/pallas_step.py"
@@ -876,25 +915,6 @@ def _specs() -> List[GraphSpec]:
     )
     out.append(
         GraphSpec(
-            name="chaos_runner@health",
-            anchor="raft_tpu/multiraft/chaos.py",
-            build=_chaos_runner_builder(),
-        )
-    )
-    out.append(
-        GraphSpec(
-            # The forensics-instrumented chaos scan (ISSUE 15): the
-            # black-box carry donated through the scan, the per-group
-            # safety fold (check_safety_groups) replacing the aggregate
-            # one, ring + trip folds per round.  The blackbox-OFF graph
-            # is the pinned chaos_runner@health row above.
-            name="chaos_runner@blackbox",
-            anchor="raft_tpu/multiraft/chaos.py",
-            build=_chaos_runner_builder(blackbox=True),
-        )
-    )
-    out.append(
-        GraphSpec(
             # The forensics-instrumented round (ISSUE 15): health + the
             # black-box trace fold riding step(blackbox=) — the
             # blackbox-OFF graphs are the bit-identical step@* rows
@@ -904,40 +924,10 @@ def _specs() -> List[GraphSpec]:
             build=_blackbox_step_builder(),
         )
     )
-    reconfig_py = "raft_tpu/multiraft/reconfig.py"
-    out.append(
-        GraphSpec(
-            # The ISSUE 10 compiled membership-churn scan: state + health
-            # + the op-protocol carry all donated; schedule arrays are
-            # runtime args (the chaos runner's GC012 lesson, applied from
-            # birth).
-            name="reconfig_runner@health",
-            anchor=reconfig_py,
-            build=_reconfig_runner_builder(False, {}),
-        )
-    )
-    out.append(
-        GraphSpec(
-            # reconfig DURING chaos in one scan, damped (cq+pv) — the
-            # BASELINE config 4 production shape.
-            name="reconfig_runner@chaos+cq+pv",
-            anchor=reconfig_py,
-            build=_reconfig_runner_builder(
-                True, {"check_quorum": True, "pre_vote": True}
-            ),
-        )
-    )
-    out.append(
-        GraphSpec(
-            # The ISSUE 11 split-horizon fused block: the production
-            # configuration's hot graph (health + counters + chaos +
-            # cq+pv), carrying the fused kernel and the k-round general
-            # fallback under one cond with the whole carry donated.
-            name=f"reconfig_split{DISPATCH_K}@chaos+cq+pv",
-            anchor=reconfig_py,
-            build=_split_runner_builder(),
-        )
-    )
+    # The compiled-runner rows (chaos/reconfig/split/workload/autopilot
+    # scans — ISSUE 9/10/11/12/13/15) are derived from the schedule
+    # registry, never hand-listed here (GC018).
+    out.extend(_runner_specs())
     sharding_py = "raft_tpu/multiraft/sharding.py"
     out.append(
         GraphSpec(

@@ -66,6 +66,19 @@ class CollectiveAuditRule(Rule):
         return False
 
 
+class PhaseBudgetRule(Rule):
+    id = "GC019"
+    slug = "phase-budget"
+    doc = (
+        "every runner variant's eqn count decomposes into base + "
+        "registered phase budgets within tolerance (duplicated phase "
+        "lowering fails) (--trace)"
+    )
+
+    def applies(self, sf: SourceFile) -> bool:
+        return False
+
+
 def trace_rules() -> List[Rule]:
     return [
         DonationAuditRule(),
@@ -73,4 +86,5 @@ def trace_rules() -> List[Rule]:
         HostSyncInGraphRule(),
         JaxprBudgetRule(),
         CollectiveAuditRule(),
+        PhaseBudgetRule(),
     ]
